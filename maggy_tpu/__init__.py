@@ -1,0 +1,33 @@
+"""maggy-tpu: distribution-transparent ML experiments on TPU.
+
+A brand-new TPU-native framework with the capabilities of logicalclocks/maggy —
+one "oblivious" ``train_fn`` runs unchanged as a local run, an async HPO trial,
+an ablation trial, or one shard of a pjit/GSPMD distributed training job.
+
+Public surface mirrors the reference (``from maggy import experiment, Searchspace``,
+maggy/__init__.py):
+
+    from maggy_tpu import experiment, Searchspace
+    from maggy_tpu.config import HyperparameterOptConfig
+    result = experiment.lagom(train_fn=train, config=cfg)
+"""
+
+from maggy_tpu.version import __version__
+from maggy_tpu.searchspace import Searchspace
+from maggy_tpu.trial import Trial
+from maggy_tpu.reporter import Reporter
+
+__all__ = ["__version__", "Searchspace", "Trial", "Reporter"]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import maggy_tpu` light (no jax import for pure-HPO use).
+    # importlib (not `from maggy_tpu import ...`) so a missing submodule raises
+    # ImportError instead of recursing through this hook.
+    import importlib
+
+    if name == "experiment":
+        return importlib.import_module("maggy_tpu.experiment")
+    if name == "AblationStudy":
+        return importlib.import_module("maggy_tpu.ablation").AblationStudy
+    raise AttributeError(f"module 'maggy_tpu' has no attribute {name!r}")

@@ -1,0 +1,18 @@
+from maggy_tpu.config.base import LagomConfig, BaseConfig
+from maggy_tpu.config.hpo import HyperparameterOptConfig
+from maggy_tpu.config.ablation import AblationConfig
+from maggy_tpu.config.distributed import DistributedConfig
+
+# Convenience alias mirroring the reference's config split (TorchDistributedConfig /
+# TfDistributedConfig, config/torch_distributed.py:28 + config/tf_distributed.py:26):
+# on TPU there is a single JAX data plane, so one config covers both.
+TpuDistributedConfig = DistributedConfig
+
+__all__ = [
+    "LagomConfig",
+    "BaseConfig",
+    "HyperparameterOptConfig",
+    "AblationConfig",
+    "DistributedConfig",
+    "TpuDistributedConfig",
+]

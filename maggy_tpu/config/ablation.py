@@ -1,0 +1,38 @@
+"""Ablation-study experiment config (reference config/ablation.py:28-67, minus the
+Spark-only guard)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from maggy_tpu.config.base import LagomConfig
+
+
+class AblationConfig(LagomConfig):
+    def __init__(
+        self,
+        ablation_study: Any,
+        ablator: Union[str, Any] = "loco",
+        direction: str = "max",
+        name: str = "ablationStudy",
+        description: str = "",
+        hb_interval: float = 1.0,
+        model: Any = None,
+        dataset: Any = None,
+        num_executors: Optional[int] = None,
+        devices_per_trial: int = 1,
+        optimization_key: str = "metric",
+        log_dir: Optional[str] = None,
+    ):
+        super().__init__(name, description, hb_interval)
+        if direction not in ("max", "min"):
+            raise ValueError(f"direction must be 'max' or 'min', got {direction!r}")
+        self.ablation_study = ablation_study
+        self.ablator = ablator
+        self.direction = direction
+        self.model = model
+        self.dataset = dataset
+        self.num_executors = num_executors
+        self.devices_per_trial = int(devices_per_trial)
+        self.optimization_key = optimization_key
+        self.log_dir = log_dir

@@ -1,0 +1,82 @@
+"""Hyperparameter-optimization experiment config.
+
+Parity with the reference ``HyperparameterOptConfig``
+(config/hyperparameter_optimization.py:33-93) minus the Spark-only guard — HPO runs
+anywhere — plus TPU scheduling knobs (``num_executors``, ``devices_per_trial``)
+that replace Spark's executor count as the trial-parallelism control.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+from maggy_tpu.config.base import LagomConfig
+from maggy_tpu.searchspace import Searchspace
+
+DIRECTIONS = ("max", "min")
+
+
+class HyperparameterOptConfig(LagomConfig):
+    def __init__(
+        self,
+        num_trials: int,
+        optimizer: Union[str, Any],
+        searchspace: Searchspace,
+        optimization_key: str = "metric",
+        direction: str = "max",
+        es_interval: int = 1,
+        es_min: int = 10,
+        es_policy: Union[str, Any] = "median",
+        name: str = "HPOptimization",
+        description: str = "",
+        hb_interval: float = 1.0,
+        model: Any = None,
+        dataset: Any = None,
+        num_executors: Optional[int] = None,
+        devices_per_trial: int = 1,
+        pruner: Optional[Union[str, Any]] = None,
+        pruner_config: Optional[dict] = None,
+        seed: Optional[int] = None,
+        log_dir: Optional[str] = None,
+    ):
+        """:param num_trials: total trials to run (pruner may override, as in the
+            reference optimization_driver.py:88-93).
+        :param optimizer: name in {"randomsearch","gridsearch","asha","tpe","gp","none"}
+            or an AbstractOptimizer instance.
+        :param searchspace: the Searchspace to explore.
+        :param optimization_key: metric name used for ranking trials.
+        :param direction: "max" or "min".
+        :param es_interval: steps between early-stop checks.
+        :param es_min: minimum finalized trials before early stopping activates.
+        :param es_policy: "median", "none", or an AbstractEarlyStop instance.
+        :param num_executors: trial workers to run concurrently; defaults to the
+            number of addressable devices // devices_per_trial.
+        :param devices_per_trial: devices leased to each trial (sub-slice size).
+        :param pruner: optional "hyperband" or AbstractPruner instance.
+        :param seed: RNG seed for samplers/surrogates.
+        """
+        super().__init__(name, description, hb_interval)
+        if not isinstance(num_trials, int) or num_trials <= 0:
+            raise ValueError("Number of trials should be greater than zero!")
+        if not isinstance(searchspace, Searchspace):
+            raise TypeError("searchspace must be a Searchspace instance")
+        if direction not in DIRECTIONS:
+            raise ValueError(f"direction must be one of {DIRECTIONS}, got {direction!r}")
+        if devices_per_trial < 1:
+            raise ValueError("devices_per_trial must be >= 1")
+        self.num_trials = num_trials
+        self.optimizer = optimizer
+        self.searchspace = searchspace
+        self.optimization_key = optimization_key
+        self.direction = direction
+        self.es_interval = int(es_interval)
+        self.es_min = int(es_min)
+        self.es_policy = es_policy
+        self.model = model
+        self.dataset = dataset
+        self.num_executors = num_executors
+        self.devices_per_trial = int(devices_per_trial)
+        self.pruner = pruner
+        self.pruner_config = dict(pruner_config or {})
+        self.seed = seed
+        self.log_dir = log_dir

@@ -1,0 +1,32 @@
+"""Framework-wide constants.
+
+Capability parity with the reference's ``maggy/constants.py`` (constants.py:23-27):
+the set of types a ``train_fn`` may return and a metric may take.
+"""
+
+import numpy as np
+
+
+class USER_FCT:
+    """Constraints on user-supplied training functions."""
+
+    # A train_fn may return a scalar metric or a dict containing the
+    # optimization key (reference constants.py:23-27).
+    RETURN_TYPES = (float, int, np.number, dict)
+    NUMERIC_TYPES = (float, int, np.number)
+
+
+# Name of the metric file written next to an experiment's outputs.
+METRIC_FILE = ".metric"
+OUTPUTS_FILE = ".outputs.json"
+HPARAMS_FILE = ".hparams.json"
+TRIAL_FILE = "trial.json"
+RESULT_FILE = "result.json"
+EXPERIMENT_FILE = "experiment.json"
+
+# RPC defaults.
+RPC_BUFSIZE = 1 << 16
+RPC_MAX_MESSAGE = 64 << 20  # 64 MiB hard cap on a single framed message
+RPC_MAX_RETRIES = 3
+RESERVATION_TIMEOUT = 600.0  # seconds (reference rpc.py:282-303)
+POLL_INTERVAL = 0.05  # client suggestion-poll interval (reference uses 1s; we poll faster)

@@ -1,0 +1,41 @@
+"""Environment singleton (reference core/environment/singleton.py:20-62).
+
+Selection: ``MAGGY_TPU_LOG_ROOT`` starting with ``gs://`` (or ``MAGGY_TPU_ENV=gcs``)
+picks the GCS environment; otherwise local filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from maggy_tpu.core.env.base import BaseEnv
+
+_instance: Optional[BaseEnv] = None
+
+
+def get_instance() -> BaseEnv:
+    global _instance
+    if _instance is None:
+        root = os.environ.get("MAGGY_TPU_LOG_ROOT", "")
+        if root.startswith("gs://") or os.environ.get("MAGGY_TPU_ENV") == "gcs":
+            from maggy_tpu.core.env.gcs import GcsEnv
+
+            _instance = GcsEnv(root or None)
+        else:
+            _instance = BaseEnv(root or None)
+    return _instance
+
+
+def set_instance(env: Optional[BaseEnv]) -> None:
+    """Override the ambient environment (used by tests and embedding apps)."""
+    global _instance
+    _instance = env
+
+
+class EnvSing:
+    """Reference-shaped accessor (singleton.py:20-62)."""
+
+    @staticmethod
+    def get_instance() -> BaseEnv:
+        return get_instance()

@@ -1,0 +1,102 @@
+"""Environment abstraction — local filesystem implementation.
+
+Parity with the reference's L0 environment layer (core/environment/base.py:25-222):
+file I/O behind a narrow interface, experiment-directory layout, and worker-count
+discovery. The reference's Hopsworks/Databricks variants become a GCS variant here
+(core/env/gcs.py) selected by path scheme or env var, keeping every upper layer
+storage-agnostic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, List, Optional
+
+
+class BaseEnv:
+    """Local-filesystem environment."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.environ.get(
+            "MAGGY_TPU_LOG_ROOT", os.path.join(os.getcwd(), "experiment_log")
+        )
+
+    # ------------------------------------------------------------------ fs ops
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def mkdir(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        if not os.path.exists(path):
+            return
+        if os.path.isdir(path):
+            if recursive:
+                shutil.rmtree(path)
+            else:
+                os.rmdir(path)
+        else:
+            os.remove(path)
+
+    def open_file(self, path: str, mode: str = "r"):
+        if "w" in mode or "a" in mode:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        return open(path, mode)
+
+    def dump(self, data: Any, path: str) -> None:
+        """Write text or JSON-serializable data to a file."""
+        with self.open_file(path, "w") as f:
+            if isinstance(data, str):
+                f.write(data)
+            else:
+                json.dump(data, f, sort_keys=True, default=str)
+
+    def load_json(self, path: str) -> Any:
+        with self.open_file(path, "r") as f:
+            return json.load(f)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(os.listdir(path)) if os.path.isdir(path) else []
+
+    # ---------------------------------------------------------- experiment dirs
+
+    def experiment_dir(self, app_id: str, run_id: int) -> str:
+        d = os.path.join(self.root, app_id, str(run_id))
+        self.mkdir(d)
+        return d
+
+    def trial_dir(self, app_id: str, run_id: int, trial_id: str) -> str:
+        d = os.path.join(self.experiment_dir(app_id, run_id), trial_id)
+        self.mkdir(d)
+        return d
+
+    # ---------------------------------------------------------- cluster info
+
+    def num_devices(self) -> int:
+        """Addressable accelerator devices on this host."""
+        try:
+            import jax
+
+            return jax.local_device_count()
+        except Exception:
+            return 1
+
+    def process_index(self) -> int:
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    def num_processes(self) -> int:
+        try:
+            import jax
+
+            return jax.process_count()
+        except Exception:
+            return 1

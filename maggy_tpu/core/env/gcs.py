@@ -1,0 +1,64 @@
+"""GCS environment — the cloud-storage analogue of the reference's HDFS/DBFS
+environments (core/environment/hopsworks.py:33, databricks.py:23).
+
+Uses ``fsspec``/``gcsfs`` when importable; otherwise raises a clear error at first
+use so local development never needs the dependency.
+"""
+
+from __future__ import annotations
+
+import posixpath
+from typing import Any, List, Optional
+
+from maggy_tpu.core.env.base import BaseEnv
+
+
+def _fs():
+    try:
+        import fsspec
+
+        return fsspec.filesystem("gs")
+    except Exception as e:  # pragma: no cover - exercised only on cloud images
+        raise RuntimeError(
+            "GCS environment requires fsspec+gcsfs; install them or use a local "
+            "MAGGY_TPU_LOG_ROOT."
+        ) from e
+
+
+class GcsEnv(BaseEnv):
+    def __init__(self, root: Optional[str] = None):
+        super().__init__(root or "gs://maggy-tpu-experiments")
+        self._fs = None
+
+    @property
+    def fs(self):
+        if self._fs is None:
+            self._fs = _fs()
+        return self._fs
+
+    def exists(self, path: str) -> bool:
+        return self.fs.exists(path)
+
+    def mkdir(self, path: str) -> None:
+        self.fs.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        if self.fs.exists(path):
+            self.fs.rm(path, recursive=recursive)
+
+    def open_file(self, path: str, mode: str = "r"):
+        # BaseEnv.dump/load_json work unchanged through this override.
+        return self.fs.open(path, mode)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(posixpath.basename(p) for p in self.fs.ls(path))
+
+    def experiment_dir(self, app_id: str, run_id: int) -> str:
+        d = posixpath.join(self.root, app_id, str(run_id))
+        self.mkdir(d)
+        return d
+
+    def trial_dir(self, app_id: str, run_id: int, trial_id: str) -> str:
+        d = posixpath.join(self.experiment_dir(app_id, run_id), trial_id)
+        self.mkdir(d)
+        return d

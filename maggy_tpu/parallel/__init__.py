@@ -1,0 +1,19 @@
+from maggy_tpu.parallel.spec import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MESH_AXES,
+    ShardingSpec,
+)
+
+__all__ = [
+    "ShardingSpec",
+    "MESH_AXES",
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_EXPERT",
+    "AXIS_SEQ",
+    "AXIS_TENSOR",
+]

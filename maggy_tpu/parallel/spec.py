@@ -1,0 +1,100 @@
+"""Mesh/sharding specification.
+
+This is the TPU-native replacement for the reference's backend/zero_lvl knobs
+(config/torch_distributed.py:31, 60-63): instead of picking DDP vs FairScale vs
+DeepSpeed engines, the user (or a preset) declares a logical device mesh with five
+axes — data, fsdp, tensor, seq, expert — and the framework lowers it to a
+``jax.sharding.Mesh`` plus NamedSharding rules. XLA then emits the collectives
+(psum/all_gather/reduce_scatter/ppermute) over ICI/DCN that NCCL provided in the
+reference (§2.9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+# Canonical mesh-axis names, in layout-priority order. ICI-heavy axes (tensor, seq)
+# should map to the innermost/physically-closest devices; `data` is outermost so
+# gradient all-reduce can ride DCN across slices if needed (scaling-book recipe).
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingSpec:
+    """Logical parallelism degrees. A value of 1 disables the axis.
+
+    ``dp``    data parallelism (batch axis; reference DDP, modules.py:38-65)
+    ``fsdp``  parameter/optimizer-state sharding (reference ZeRO-1..3/FSDP,
+              optim.py:28-117 + modules.py:68-97)
+    ``tp``    tensor parallelism (attention heads / MLP hidden)
+    ``sp``    sequence/context parallelism (ring attention; absent in reference,
+              SURVEY.md §5.7)
+    ``ep``    expert parallelism for MoE (absent in reference, §2.10)
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"ShardingSpec.{f.name} must be a positive int, got {v!r}")
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+
+    @classmethod
+    def preset(cls, name: str, num_devices: int) -> "ShardingSpec":
+        """Named presets mirroring the reference's strategy strings.
+
+        "dp" → pure data parallel; "fsdp"/"zero" → ZeRO-3-style full sharding;
+        "tp" → tensor parallel; "2d" → fsdp×tp split; "sp" → sequence parallel;
+        "ep" → expert parallel with fsdp remainder.
+        """
+        n = num_devices
+        if name in ("dp", "ddp"):
+            return cls(dp=n)
+        if name in ("fsdp", "zero", "zero3"):
+            return cls(fsdp=n)
+        if name == "tp":
+            return cls(tp=n)
+        if name == "sp":
+            return cls(sp=n)
+        if name == "2d":
+            tp = _largest_factor_leq(n, max(1, int(n**0.5)))
+            return cls(fsdp=n // tp, tp=tp)
+        if name == "ep":
+            ep = _largest_factor_leq(n, max(1, int(n**0.5)))
+            return cls(ep=ep, fsdp=n // ep)
+        raise ValueError(f"Unknown sharding preset {name!r}")
+
+    def scaled_to(self, num_devices: int) -> "ShardingSpec":
+        """Grow/shrink the dp axis so the spec covers exactly ``num_devices``."""
+        rest = self.fsdp * self.tp * self.sp * self.ep
+        if num_devices % rest != 0:
+            raise ValueError(
+                f"{num_devices} devices not divisible by non-dp axes product {rest}"
+            )
+        return dataclasses.replace(self, dp=num_devices // rest)
+
+
+def _largest_factor_leq(n: int, cap: int) -> int:
+    for f in range(min(cap, n), 0, -1):
+        if n % f == 0:
+            return f
+    return 1

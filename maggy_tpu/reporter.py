@@ -1,0 +1,103 @@
+"""Worker-side metric/log reporter.
+
+Capability parity with the reference ``maggy/core/reporter.py`` (reporter.py:30-170):
+a thread-safe store that the user's ``train_fn`` broadcasts metrics into, that the
+heartbeat thread drains toward the driver, and that turns a driver-issued STOP into an
+``EarlyStopException`` raised at the next ``broadcast()`` call — the mechanism that
+lets early stopping interrupt a Python-level training loop between jitted steps
+(SURVEY.md §7 "Early stopping inside jitted training loops").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import numpy as np
+
+from maggy_tpu import exceptions
+
+
+class Reporter:
+    """Thread-safe metric and log buffer for one executor."""
+
+    def __init__(self, log_file: Optional[str] = None, partition_id: int = 0, print_hook=None):
+        self._lock = threading.RLock()
+        self._metric: Optional[float] = None
+        self._step: int = -1
+        self._early_stop = False
+        self._logs: List[str] = []
+        self._log_file = log_file
+        self._log_fd = open(log_file, "a", buffering=1) if log_file else None
+        self.partition_id = partition_id
+        self.trial_id: Optional[str] = None
+        self._print_hook = print_hook
+
+    # ------------------------------------------------------------------ metrics
+
+    def broadcast(self, metric: Any, step: Optional[int] = None) -> None:
+        """Record a metric observation for the current trial.
+
+        Validates metric and step types, enforces monotonically increasing steps,
+        and raises :class:`EarlyStopException` when the driver flagged this trial
+        (reference reporter.py:77-101).
+        """
+        with self._lock:
+            if not isinstance(metric, (int, float, np.number)) or isinstance(metric, bool):
+                raise exceptions.BroadcastMetricTypeError(metric)
+            if step is not None and (not isinstance(step, (int, np.integer)) or isinstance(step, bool)):
+                raise exceptions.BroadcastStepTypeError(metric, step)
+            if step is None:
+                step = self._step + 1
+            step = int(step)
+            if step <= self._step:
+                raise exceptions.BroadcastStepValueError(metric, step, self._step)
+            self._metric = float(metric)
+            self._step = step
+            if self._early_stop:
+                # The flag stays set (cleared only by reset()) so a train_fn that
+                # swallows the exception keeps being interrupted at every broadcast.
+                raise exceptions.EarlyStopException(metric=self._metric)
+
+    def get_data(self):
+        """Drain pending logs and return ``(metric, step, logs)`` for a heartbeat
+        (reference reporter.py:137-142)."""
+        with self._lock:
+            logs, self._logs = self._logs, []
+            return self._metric, self._step, logs
+
+    def get_metric(self):
+        with self._lock:
+            return self._metric
+
+    # ------------------------------------------------------------------ early stop
+
+    def early_stop(self) -> None:
+        with self._lock:
+            self._early_stop = True
+
+    def reset(self, trial_id: Optional[str] = None) -> None:
+        """Reset per-trial state before a new trial starts (reference reporter.py:56-74)."""
+        with self._lock:
+            self._metric = None
+            self._step = -1
+            self._early_stop = False
+            self.trial_id = trial_id
+
+    # ------------------------------------------------------------------ logging
+
+    def log(self, message: str, verbose: bool = True) -> None:
+        """Buffer a log line for shipping to the driver; optionally echo locally."""
+        line = str(message)
+        with self._lock:
+            self._logs.append(line)
+            if self._log_fd:
+                self._log_fd.write(line.rstrip("\n") + "\n")
+        if verbose and self._print_hook:
+            self._print_hook(line)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._log_fd:
+                self._log_fd.close()
+                self._log_fd = None

@@ -1,0 +1,168 @@
+"""Trial record and state machine.
+
+Capability parity with the reference ``maggy/trial.py`` (trial.py:24-176): the five
+states PENDING/SCHEDULED/RUNNING/ERROR/FINALIZED, a deterministic trial id (16-char
+md5 prefix over the sorted-params JSON — same scheme as trial.py:110-136 so ids are
+comparable across frameworks), thread-safe metric appends deduplicated by step, an
+early-stop flag, and JSON (de)serialization.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Trial:
+    PENDING = "PENDING"
+    SCHEDULED = "SCHEDULED"
+    RUNNING = "RUNNING"
+    ERROR = "ERROR"
+    FINALIZED = "FINALIZED"
+
+    STATES = (PENDING, SCHEDULED, RUNNING, ERROR, FINALIZED)
+
+    def __init__(
+        self,
+        params: Dict[str, Any],
+        trial_type: str = "optimization",
+        info_dict: Optional[Dict[str, Any]] = None,
+    ):
+        if not isinstance(params, dict):
+            raise TypeError(f"Trial params must be a dict, got {type(params).__name__}")
+        self.params = dict(params)
+        self.trial_type = trial_type
+        self.trial_id = self.compute_id(self.params)
+        self.status = Trial.PENDING
+        self.info_dict = dict(info_dict or {})
+
+        self.final_metric: Optional[float] = None
+        self.metric_history: List[float] = []
+        self.step_history: List[int] = []
+        self.start: Optional[float] = None
+        self.duration: Optional[float] = None
+        self.assigned_to: Optional[int] = None  # partition/executor id
+
+        self._early_stop = False
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------ identity
+
+    @staticmethod
+    def compute_id(params: Dict[str, Any]) -> str:
+        """16-char md5 prefix of the canonical params JSON (reference trial.py:110-136)."""
+        canonical = json.dumps(params, sort_keys=True, default=str, separators=(",", ":"))
+        return hashlib.md5(canonical.encode("utf-8")).hexdigest()[:16]
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def schedule(self, partition_id: int) -> None:
+        with self._lock:
+            self.status = Trial.SCHEDULED
+            self.assigned_to = partition_id
+
+    def begin(self) -> None:
+        with self._lock:
+            self.status = Trial.RUNNING
+            self.start = time.time()
+
+    def finalize(self, final_metric: Optional[float] = None) -> None:
+        with self._lock:
+            if final_metric is not None:
+                self.final_metric = float(final_metric)
+            self.status = Trial.FINALIZED
+            if self.start is not None:
+                self.duration = time.time() - self.start
+
+    def error(self) -> None:
+        with self._lock:
+            self.status = Trial.ERROR
+
+    # ------------------------------------------------------------------ metrics
+
+    def append_metric(self, metric: float, step: Optional[int] = None) -> bool:
+        """Record one (metric, step) observation; duplicate steps are dropped
+        (reference trial.py:93-108). Returns True if recorded."""
+        with self._lock:
+            if step is None:
+                step = self.step_history[-1] + 1 if self.step_history else 0
+            step = int(step)
+            if self.step_history and step <= self.step_history[-1]:
+                return False
+            self.metric_history.append(float(metric))
+            self.step_history.append(step)
+            return True
+
+    @property
+    def metrics(self) -> List[float]:
+        with self._lock:
+            return list(self.metric_history)
+
+    def running_avg(self, up_to_step: Optional[int] = None) -> Optional[float]:
+        """Mean of metrics observed at steps <= ``up_to_step`` (median-rule substrate)."""
+        with self._lock:
+            vals = [
+                m
+                for m, s in zip(self.metric_history, self.step_history)
+                if up_to_step is None or s <= up_to_step
+            ]
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
+
+    # ------------------------------------------------------------------ early stop
+
+    def set_early_stop(self) -> None:
+        with self._lock:
+            self._early_stop = True
+
+    def get_early_stop(self) -> bool:
+        with self._lock:
+            return self._early_stop
+
+    # ------------------------------------------------------------------ serialization
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "trial_id": self.trial_id,
+                "trial_type": self.trial_type,
+                "params": self.params,
+                "status": self.status,
+                "final_metric": self.final_metric,
+                "metric_history": list(self.metric_history),
+                "step_history": list(self.step_history),
+                "start": self.start,
+                "duration": self.duration,
+                "early_stop": self._early_stop,
+                "info_dict": self.info_dict,
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True, default=str)
+
+    @classmethod
+    def from_dict(cls, payload: Dict[str, Any]) -> "Trial":
+        t = cls(payload["params"], payload.get("trial_type", "optimization"))
+        t.status = payload.get("status", Trial.PENDING)
+        t.final_metric = payload.get("final_metric")
+        t.metric_history = [float(m) for m in payload.get("metric_history", [])]
+        t.step_history = [int(s) for s in payload.get("step_history", [])]
+        t.start = payload.get("start")
+        t.duration = payload.get("duration")
+        t._early_stop = bool(payload.get("early_stop", False))
+        t.info_dict = payload.get("info_dict", {}) or {}
+        return t
+
+    @classmethod
+    def from_json(cls, payload: str) -> "Trial":
+        return cls.from_dict(json.loads(payload))
+
+    def __repr__(self) -> str:
+        return (
+            f"Trial(id={self.trial_id}, status={self.status}, "
+            f"final_metric={self.final_metric}, params={self.params})"
+        )

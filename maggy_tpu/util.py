@@ -1,0 +1,136 @@
+"""Cross-cutting utilities.
+
+Covers the reference ``maggy/util.py`` capabilities the TPU build needs:
+return-value validation/persistence (util.py:159-199), signature-based kwarg
+injection (trial_executor.py:166-179 semantics, hoisted here so every executor
+shares it), run-id bookkeeping, and an ASCII progress bar (util.py:79-94).
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from maggy_tpu import constants, exceptions
+
+
+def inject_kwargs(fn: Callable, available: Dict[str, Any]) -> Dict[str, Any]:
+    """Inspect ``fn``'s signature and return only the kwargs it asks for.
+
+    This is the mechanism behind the "oblivious training function": the same
+    ``train_fn`` may request any subset of ``{model, dataset, hparams, reporter,
+    mesh, train_ctx, ...}`` and runs unchanged in every execution mode
+    (reference trial_executor.py:166-179).
+    """
+    sig = inspect.signature(fn)
+    params = sig.parameters
+    if any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()):
+        return dict(available)
+    return {k: v for k, v in available.items() if k in params}
+
+
+def handle_return_val(
+    return_val: Any,
+    log_dir: Optional[str],
+    optimization_key: str,
+    log_file: Optional[str] = None,
+) -> float:
+    """Validate a train_fn return value and persist outputs (reference util.py:159-199).
+
+    Numeric returns are used directly; dict returns must contain the optimization
+    key with a numeric value. Writes ``.outputs.json`` and ``.metric`` into the
+    trial log dir when one is given.
+    """
+    if isinstance(return_val, constants.USER_FCT.NUMERIC_TYPES) and not isinstance(
+        return_val, bool
+    ):
+        metric = float(return_val)
+        outputs = {optimization_key: metric}
+    elif isinstance(return_val, dict):
+        if optimization_key not in return_val:
+            raise exceptions.ReturnTypeError(optimization_key, return_val)
+        metric = return_val[optimization_key]
+        if not isinstance(metric, constants.USER_FCT.NUMERIC_TYPES) or isinstance(
+            metric, bool
+        ):
+            raise exceptions.MetricTypeError(optimization_key, metric)
+        metric = float(metric)
+        outputs = return_val
+    elif return_val is None:
+        raise exceptions.ReturnTypeError(optimization_key, return_val)
+    else:
+        raise exceptions.ReturnTypeError(optimization_key, return_val)
+
+    if log_dir:
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            with open(os.path.join(log_dir, constants.OUTPUTS_FILE), "w") as f:
+                json.dump(_jsonify(outputs), f, sort_keys=True)
+            with open(os.path.join(log_dir, constants.METRIC_FILE), "w") as f:
+                f.write(repr(metric))
+        except OSError as e:
+            logging.getLogger(__name__).warning(
+                "Could not persist trial outputs to %s: %s", log_dir, e
+            )
+    return metric
+
+
+def _jsonify(obj: Any) -> Any:
+    """Best-effort conversion of numpy/jax scalars and arrays for JSON dumps."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)
+
+
+def progress_bar(done: int, total: int, width: int = 30) -> str:
+    """ASCII progress bar (reference util.py:79-94)."""
+    total = max(total, 1)
+    frac = min(done / total, 1.0)
+    filled = int(width * frac)
+    return "[" + "=" * filled + ">" + "-" * (width - filled) + f"] {done}/{total}"
+
+
+def new_app_id() -> str:
+    """Fabricate an application id in the reference's format
+    (experiment_python.py:71-72)."""
+    return "application_{}_0001".format(int(time.time()))
+
+
+def seed_everything(seed: int) -> np.random.Generator:
+    """Return a seeded numpy Generator; JAX randomness is functional (jax.random.key)
+    so nothing global needs patching — the idiomatic replacement for the reference's
+    torch/np/random/cudnn seeding (torch_dist_executor.py:247-285)."""
+    return np.random.default_rng(seed)
+
+
+class RunRegistry:
+    """Per-process experiment run-id bookkeeping (reference util.py:216-290)."""
+
+    def __init__(self):
+        self._run_ids: Dict[str, int] = {}
+
+    def next_run_id(self, app_id: str) -> int:
+        rid = self._run_ids.get(app_id, 0) + 1
+        self._run_ids[app_id] = rid
+        return rid
+
+
+RUNS = RunRegistry()

@@ -1,0 +1,93 @@
+"""Config validation + util (kwarg injection, return-val handling, ShardingSpec)."""
+
+import os
+
+import pytest
+
+from maggy_tpu import Searchspace, exceptions, util
+from maggy_tpu.config import (
+    AblationConfig,
+    BaseConfig,
+    DistributedConfig,
+    HyperparameterOptConfig,
+)
+from maggy_tpu.parallel import ShardingSpec
+
+
+def sp():
+    return Searchspace(lr=("DOUBLE", [0.0, 1.0]))
+
+
+def test_hpo_config_validation():
+    cfg = HyperparameterOptConfig(num_trials=4, optimizer="randomsearch", searchspace=sp())
+    assert cfg.direction == "max"
+    with pytest.raises(ValueError):
+        HyperparameterOptConfig(num_trials=0, optimizer="randomsearch", searchspace=sp())
+    with pytest.raises(ValueError):
+        HyperparameterOptConfig(
+            num_trials=2, optimizer="randomsearch", searchspace=sp(), direction="up"
+        )
+    with pytest.raises(TypeError):
+        HyperparameterOptConfig(num_trials=2, optimizer="randomsearch", searchspace={})
+
+
+def test_distributed_config_zero_shim():
+    cfg = DistributedConfig(module=object, zero_lvl=3)
+    assert cfg.sharding == "fsdp"
+    cfg = DistributedConfig(module=object, zero_lvl=0)
+    assert cfg.sharding == "dp"
+    with pytest.raises(ValueError):
+        DistributedConfig(module=object, zero_lvl=5)
+    spec = cfg.resolve_sharding(8)
+    assert spec.dp == 8 and spec.num_devices == 8
+
+
+def test_sharding_spec():
+    s = ShardingSpec(dp=2, fsdp=2, tp=2)
+    assert s.num_devices == 8
+    assert ShardingSpec.preset("fsdp", 8) == ShardingSpec(fsdp=8)
+    two_d = ShardingSpec.preset("2d", 8)
+    assert two_d.fsdp * two_d.tp == 8 and two_d.tp == 2
+    with pytest.raises(ValueError):
+        ShardingSpec(dp=0)
+    assert ShardingSpec(fsdp=4).scaled_to(8) == ShardingSpec(dp=2, fsdp=4)
+    with pytest.raises(ValueError):
+        ShardingSpec(fsdp=3).scaled_to(8)
+
+
+def test_inject_kwargs():
+    def fn_a(hparams, reporter):
+        return hparams, reporter
+
+    def fn_b():
+        return None
+
+    def fn_c(**kwargs):
+        return kwargs
+
+    avail = {"hparams": {"x": 1}, "reporter": "R", "model": "M"}
+    assert util.inject_kwargs(fn_a, avail) == {"hparams": {"x": 1}, "reporter": "R"}
+    assert util.inject_kwargs(fn_b, avail) == {}
+    assert util.inject_kwargs(fn_c, avail) == avail
+
+
+def test_handle_return_val(tmp_path):
+    d = str(tmp_path / "trial")
+    assert util.handle_return_val(0.5, d, "metric") == 0.5
+    assert os.path.exists(os.path.join(d, ".metric"))
+    assert util.handle_return_val({"metric": 2, "loss": 0.1}, d, "metric") == 2.0
+    with pytest.raises(exceptions.ReturnTypeError):
+        util.handle_return_val(None, d, "metric")
+    with pytest.raises(exceptions.ReturnTypeError):
+        util.handle_return_val({"loss": 0.1}, d, "metric")
+    with pytest.raises(exceptions.MetricTypeError):
+        util.handle_return_val({"metric": "bad"}, d, "metric")
+
+
+def test_base_and_ablation_config():
+    c = BaseConfig(hparams={"a": 1})
+    assert c.hparams == {"a": 1}
+    a = AblationConfig(ablation_study=object())
+    assert a.ablator == "loco"
+    with pytest.raises(ValueError):
+        AblationConfig(ablation_study=object(), direction="sideways")

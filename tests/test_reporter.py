@@ -1,0 +1,70 @@
+"""Reporter tests: broadcast validation, monotonic steps, early-stop exception,
+log draining (reference reporter.py:77-142 semantics)."""
+
+import numpy as np
+import pytest
+
+from maggy_tpu import Reporter, exceptions
+
+
+def test_broadcast_and_drain():
+    r = Reporter()
+    r.broadcast(0.5)
+    r.broadcast(0.6, step=5)
+    r.log("hello")
+    metric, step, logs = r.get_data()
+    assert metric == 0.6 and step == 5
+    assert logs == ["hello"]
+    # logs drained
+    assert r.get_data()[2] == []
+
+
+def test_broadcast_type_validation():
+    r = Reporter()
+    with pytest.raises(exceptions.BroadcastMetricTypeError):
+        r.broadcast("not-a-number")
+    with pytest.raises(exceptions.BroadcastMetricTypeError):
+        r.broadcast(True)
+    with pytest.raises(exceptions.BroadcastStepTypeError):
+        r.broadcast(0.5, step=1.5)
+    r.broadcast(np.float32(0.5))  # numpy scalars are fine
+    r.broadcast(0.7, step=np.int64(10))
+
+
+def test_monotonic_steps():
+    r = Reporter()
+    r.broadcast(0.5, step=3)
+    with pytest.raises(exceptions.BroadcastStepValueError):
+        r.broadcast(0.6, step=3)
+    with pytest.raises(exceptions.BroadcastStepValueError):
+        r.broadcast(0.6, step=1)
+    r.broadcast(0.6, step=4)
+
+
+def test_early_stop_raises_on_next_broadcast():
+    r = Reporter()
+    r.broadcast(0.1, step=0)
+    r.early_stop()
+    with pytest.raises(exceptions.EarlyStopException) as ei:
+        r.broadcast(0.2, step=1)
+    # the metric is preserved on the exception (reference trial_executor.py:194-196)
+    assert ei.value.metric == 0.2
+
+
+def test_reset_clears_state():
+    r = Reporter()
+    r.broadcast(0.5, step=9)
+    r.early_stop()
+    r.reset(trial_id="abc")
+    assert r.trial_id == "abc"
+    assert r.get_metric() is None
+    r.broadcast(0.1, step=0)  # no EarlyStopException, steps restart
+
+
+def test_log_file(tmp_path):
+    p = tmp_path / "exec.log"
+    r = Reporter(log_file=str(p))
+    r.log("line1", verbose=False)
+    r.log("line2", verbose=False)
+    r.close()
+    assert p.read_text().splitlines() == ["line1", "line2"]

@@ -1,0 +1,115 @@
+"""Searchspace tests — mirrors the reference suite's construction/validation
+coverage (maggy/tests/test_searchspace.py:24-77) and adds round-trip property
+tests for the unit-cube transform."""
+
+import pytest
+
+from maggy_tpu import Searchspace
+
+
+def make_space():
+    return Searchspace(
+        lr=("DOUBLE", [1e-4, 1e-1]),
+        layers=("INTEGER", [1, 8]),
+        batch=("DISCRETE", [32, 64, 128]),
+        act=("CATEGORICAL", ["relu", "gelu", "silu"]),
+    )
+
+
+def test_construction_and_accessors():
+    sp = make_space()
+    assert len(sp) == 4
+    assert sp.names() == {
+        "lr": "DOUBLE",
+        "layers": "INTEGER",
+        "batch": "DISCRETE",
+        "act": "CATEGORICAL",
+    }
+    assert sp.lr == [1e-4, 1e-1]
+    assert sp.get("layers") == [1, 8]
+    assert "batch" in sp
+    # lower-case type strings are accepted
+    sp2 = Searchspace(x=("double", [0.0, 1.0]))
+    assert sp2.get_type("x") == Searchspace.DOUBLE
+
+
+def test_to_dict_roundtrip():
+    sp = make_space()
+    sp2 = Searchspace(**sp.to_dict())
+    assert sp2.to_dict() == sp.to_dict()
+    sp3 = Searchspace.from_json(sp.json())
+    assert sp3.to_dict() == sp.to_dict()
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        ("DOUBLE", [1.0]),  # wrong arity
+        ("DOUBLE", [1.0, 1.0]),  # lo == hi
+        ("DOUBLE", [2.0, 1.0]),  # lo > hi
+        ("DOUBLE", ["a", 1.0]),  # non-numeric
+        ("INTEGER", [1.5, 2]),  # non-int bounds
+        ("DISCRETE", []),  # empty
+        ("DISCRETE", [1, 1]),  # duplicates
+        ("CATEGORICAL", ["a", "a"]),  # duplicates
+        ("WRONG", [1, 2]),  # bad type
+        ("DOUBLE",),  # bad shape
+    ],
+)
+def test_add_validation_errors(value):
+    sp = Searchspace()
+    with pytest.raises(ValueError):
+        sp.add("x", value)
+
+
+def test_reserved_and_duplicate_names():
+    sp = Searchspace(x=("DOUBLE", [0.0, 1.0]))
+    with pytest.raises(ValueError):
+        sp.add("x", ("DOUBLE", [0.0, 1.0]))
+    with pytest.raises(ValueError):
+        sp.add("add", ("DOUBLE", [0.0, 1.0]))
+    with pytest.raises(ValueError):
+        sp.add("_private", ("DOUBLE", [0.0, 1.0]))
+
+
+def test_sampling_in_bounds():
+    sp = make_space()
+    for params in sp.get_random_parameter_values(100, seed=7):
+        assert sp.contains(params)
+    # determinism with a seed
+    a = sp.get_random_parameter_values(10, seed=3)
+    b = sp.get_random_parameter_values(10, seed=3)
+    assert a == b
+
+
+def test_transform_roundtrip_exact():
+    sp = make_space()
+    for params in sp.get_random_parameter_values(200, seed=11):
+        vec = sp.transform(params)
+        assert vec.shape == (4,)
+        assert (vec >= 0).all() and (vec <= 1).all()
+        back = sp.inverse_transform(vec)
+        assert back["layers"] == params["layers"]
+        assert back["batch"] == params["batch"]
+        assert back["act"] == params["act"]
+        assert abs(back["lr"] - params["lr"]) < 1e-12
+
+
+def test_inverse_transform_any_point_valid():
+    import numpy as np
+
+    sp = make_space()
+    rng = np.random.default_rng(0)
+    for _ in range(100):
+        params = sp.inverse_transform(rng.random(4))
+        assert sp.contains(params)
+    # boundary values decode to valid configs too
+    assert sp.contains(sp.inverse_transform(np.zeros(4)))
+    assert sp.contains(sp.inverse_transform(np.ones(4)))
+
+
+def test_dict_list_converters():
+    sp = make_space()
+    params = sp.sample()
+    values = sp.dict_to_list(params)
+    assert sp.list_to_dict(values) == params

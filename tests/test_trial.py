@@ -1,0 +1,70 @@
+"""Trial tests — mirrors reference maggy/tests/test_trial.py:24-48 (deterministic
+id, json round-trip) plus state machine and metric dedup."""
+
+import json
+
+from maggy_tpu import Trial
+
+
+def test_deterministic_id():
+    t1 = Trial({"lr": 0.01, "layers": 3})
+    t2 = Trial({"layers": 3, "lr": 0.01})  # key order must not matter
+    assert t1.trial_id == t2.trial_id
+    assert len(t1.trial_id) == 16
+    t3 = Trial({"lr": 0.02, "layers": 3})
+    assert t3.trial_id != t1.trial_id
+
+
+def test_state_machine():
+    t = Trial({"x": 1})
+    assert t.status == Trial.PENDING
+    t.schedule(partition_id=2)
+    assert t.status == Trial.SCHEDULED and t.assigned_to == 2
+    t.begin()
+    assert t.status == Trial.RUNNING and t.start is not None
+    t.finalize(0.97)
+    assert t.status == Trial.FINALIZED
+    assert t.final_metric == 0.97
+    assert t.duration is not None and t.duration >= 0
+
+
+def test_append_metric_dedup_by_step():
+    t = Trial({"x": 1})
+    assert t.append_metric(0.5, step=0)
+    assert t.append_metric(0.6, step=1)
+    assert not t.append_metric(0.7, step=1)  # duplicate step dropped
+    assert not t.append_metric(0.7, step=0)  # regression dropped
+    assert t.append_metric(0.7)  # auto-increment to 2
+    assert t.metrics == [0.5, 0.6, 0.7]
+    assert t.step_history == [0, 1, 2]
+
+
+def test_running_avg():
+    t = Trial({"x": 1})
+    for s, m in enumerate([1.0, 2.0, 3.0, 4.0]):
+        t.append_metric(m, step=s)
+    assert t.running_avg() == 2.5
+    assert t.running_avg(up_to_step=1) == 1.5
+    assert Trial({"y": 0}).running_avg() is None
+
+
+def test_json_roundtrip():
+    t = Trial({"lr": 0.1, "act": "relu"}, info_dict={"budget": 9})
+    t.append_metric(0.3, step=0)
+    t.begin()
+    t.finalize(0.9)
+    payload = t.to_json()
+    json.loads(payload)  # valid json
+    t2 = Trial.from_json(payload)
+    assert t2.trial_id == t.trial_id
+    assert t2.status == Trial.FINALIZED
+    assert t2.final_metric == 0.9
+    assert t2.metric_history == [0.3]
+    assert t2.info_dict == {"budget": 9}
+
+
+def test_early_stop_flag():
+    t = Trial({"x": 1})
+    assert not t.get_early_stop()
+    t.set_early_stop()
+    assert t.get_early_stop()
