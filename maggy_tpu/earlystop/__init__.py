@@ -1,0 +1,5 @@
+from maggy_tpu.earlystop.abstractearlystop import AbstractEarlyStop
+from maggy_tpu.earlystop.medianrule import MedianStoppingRule
+from maggy_tpu.earlystop.nostop import NoStoppingRule
+
+__all__ = ["AbstractEarlyStop", "MedianStoppingRule", "NoStoppingRule"]

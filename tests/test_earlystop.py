@@ -1,0 +1,57 @@
+"""Median stopping rule tests (reference earlystop/medianrule.py:27-60 semantics)."""
+
+from maggy_tpu import Trial
+from maggy_tpu.earlystop import MedianStoppingRule, NoStoppingRule
+
+
+def finalized_trial(metrics):
+    t = Trial({"id": repr(metrics)})
+    for s, m in enumerate(metrics):
+        t.append_metric(m, step=s)
+    t.finalize(metrics[-1])
+    return t
+
+
+def running_trial(metrics):
+    t = Trial({"id": "running" + repr(metrics)})
+    t.begin()
+    for s, m in enumerate(metrics):
+        t.append_metric(m, step=s)
+    return t
+
+
+def test_median_rule_stops_bad_trial_max():
+    final = [finalized_trial([0.5, 0.6, 0.7]), finalized_trial([0.4, 0.5, 0.6])]
+    bad = running_trial([0.1, 0.1, 0.1])
+    good = running_trial([0.9, 0.9, 0.9])
+    out = MedianStoppingRule.earlystop_check(
+        {"bad": bad, "good": good}, final, direction="max"
+    )
+    assert out == ["bad"]
+
+
+def test_median_rule_direction_min():
+    final = [finalized_trial([0.5, 0.4]), finalized_trial([0.6, 0.5])]
+    bad = running_trial([2.0, 2.0])  # high loss -> stop under min
+    good = running_trial([0.1, 0.1])
+    out = MedianStoppingRule.earlystop_check(
+        {"bad": bad, "good": good}, final, direction="min"
+    )
+    assert out == ["bad"]
+
+
+def test_median_rule_no_finalized_no_stop():
+    assert (
+        MedianStoppingRule.earlystop_check({"x": running_trial([0.0])}, [], "max") == []
+    )
+
+
+def test_median_rule_ignores_metricless_running_trial():
+    final = [finalized_trial([0.5])]
+    t = Trial({"fresh": 1})
+    assert MedianStoppingRule.earlystop_check({"fresh": t}, final, "max") == []
+
+
+def test_nostop():
+    final = [finalized_trial([0.5])]
+    assert NoStoppingRule.earlystop_check({"x": running_trial([0.0])}, final, "max") == []

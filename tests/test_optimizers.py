@@ -1,0 +1,164 @@
+"""Controller tests with a simulated metric oracle (SURVEY.md §4 implication:
+optimizers are deterministic given seeded RNG — no cluster needed)."""
+
+import pytest
+
+from maggy_tpu import Searchspace, Trial
+from maggy_tpu.optimizer import (
+    IDLE,
+    Asha,
+    GridSearch,
+    RandomSearch,
+    SingleRun,
+    get_optimizer,
+)
+
+
+def space():
+    return Searchspace(
+        lr=("DOUBLE", [0.001, 1.0]),
+        width=("INTEGER", [8, 64]),
+        act=("CATEGORICAL", ["relu", "gelu"]),
+    )
+
+
+def drive(opt, oracle, max_steps=10_000):
+    """Minimal driver loop: run trials to completion serially."""
+    finished = []
+    while True:
+        suggestion = opt.get_suggestion()
+        if suggestion is None:
+            break
+        if suggestion == IDLE:
+            # serial driver: IDLE with nothing in flight would spin forever
+            assert opt.trial_store, "IDLE returned with no busy trials"
+            break
+        opt.trial_store[suggestion.trial_id] = suggestion
+        suggestion.begin()
+        suggestion.finalize(oracle(suggestion.params))
+        del opt.trial_store[suggestion.trial_id]
+        opt.final_store.append(suggestion)
+        finished.append(suggestion)
+        assert len(finished) < max_steps
+    return finished
+
+
+def wire(opt, num_trials, direction="max"):
+    opt.setup(space(), num_trials, {}, [], direction=direction)
+    return opt
+
+
+def test_randomsearch_runs_all_unique_trials():
+    opt = wire(RandomSearch(seed=1), 20)
+    finished = drive(opt, lambda p: p["lr"])
+    assert len(finished) == 20
+    assert len({t.trial_id for t in finished}) == 20
+    for t in finished:
+        assert opt.searchspace.contains({k: v for k, v in t.params.items() if k != "budget"})
+
+
+def test_randomsearch_seed_determinism():
+    a = drive(wire(RandomSearch(seed=7), 10), lambda p: 0.0)
+    b = drive(wire(RandomSearch(seed=7), 10), lambda p: 0.0)
+    assert [t.trial_id for t in a] == [t.trial_id for t in b]
+
+
+def test_gridsearch_covers_cartesian_product():
+    sp = Searchspace(
+        batch=("DISCRETE", [32, 64]),
+        act=("CATEGORICAL", ["relu", "gelu"]),
+        depth=("INTEGER", [1, 3]),
+    )
+    n = GridSearch.get_num_trials(sp)
+    assert n == 2 * 2 * 3
+    opt = GridSearch()
+    opt.setup(sp, n, {}, [])
+    finished = drive(opt, lambda p: 0.0)
+    assert len(finished) == n
+    combos = {(t.params["batch"], t.params["act"], t.params["depth"]) for t in finished}
+    assert len(combos) == n
+
+
+def test_gridsearch_grids_continuous_axes():
+    sp = Searchspace(lr=("DOUBLE", [0.0, 1.0]))
+    assert GridSearch.get_num_trials(sp, grid_points=4) == 4
+    opt = GridSearch(grid_points=4)
+    opt.setup(sp, 4, {}, [])
+    lrs = [t.params["lr"] for t in drive(opt, lambda p: 0.0)]
+    assert lrs == [0.0, pytest.approx(1 / 3), pytest.approx(2 / 3), 1.0]
+
+
+def test_singlerun():
+    opt = SingleRun()
+    opt.setup(space(), 3, {}, [])
+    finished = drive(opt, lambda p: 1.0)
+    assert len(finished) == 3
+
+
+def test_asha_budgets_and_promotion_direction_max():
+    opt = Asha(reduction_factor=2, resource_min=1, resource_max=4, seed=3)
+    opt.setup(space(), 8, {}, [], direction="max")
+    assert opt.budgets == [1, 2, 4]
+    # oracle: bigger lr is better — promotions should chase high-lr configs
+    finished = drive(opt, lambda p: p["lr"])
+    base = [t for t in finished if t.params["budget"] == 1]
+    rung1 = [t for t in finished if t.params["budget"] == 2]
+    rung2 = [t for t in finished if t.params["budget"] == 4]
+    assert len(base) == 8
+    assert len(rung1) == len(base) // 2
+    assert len(rung2) == len(rung1) // 2
+    # the best base config must have been promoted (direction respected)
+    best_base = max(base, key=lambda t: t.final_metric)
+    assert {k: v for k, v in best_base.params.items() if k != "budget"} in [
+        {k: v for k, v in t.params.items() if k != "budget"} for t in rung1
+    ]
+
+
+def test_asha_promotion_direction_min():
+    opt = Asha(reduction_factor=2, resource_min=1, resource_max=2, seed=3)
+    opt.setup(space(), 4, {}, [], direction="min")
+    finished = drive(opt, lambda p: p["lr"])
+    base = [t for t in finished if t.params["budget"] == 1]
+    promoted = [t for t in finished if t.params["budget"] == 2]
+    best_base = min(base, key=lambda t: t.final_metric)
+    assert len(promoted) == 2
+    promoted_configs = [
+        {k: v for k, v in t.params.items() if k != "budget"} for t in promoted
+    ]
+    assert {k: v for k, v in best_base.params.items() if k != "budget"} in promoted_configs
+
+
+def test_asha_validation():
+    with pytest.raises(ValueError):
+        Asha(reduction_factor=1)
+    with pytest.raises(ValueError):
+        Asha(resource_min=4, resource_max=2)
+
+
+def test_registry():
+    assert isinstance(get_optimizer("randomsearch"), RandomSearch)
+    assert isinstance(get_optimizer("asha"), Asha)
+    assert isinstance(get_optimizer(None), SingleRun)
+    inst = RandomSearch()
+    assert get_optimizer(inst) is inst
+    with pytest.raises(ValueError):
+        get_optimizer("simulated-annealing")
+
+
+def test_metrics_array_negation():
+    opt = wire(RandomSearch(seed=5), 5, direction="max")
+    finished = drive(opt, lambda p: p["lr"])
+    y = opt.get_metrics_array()
+    assert (y <= 0).all()  # negated under max
+    assert opt.ybest() == -max(t.final_metric for t in finished)
+    opt2 = wire(RandomSearch(seed=5), 5, direction="min")
+    drive(opt2, lambda p: p["lr"])
+    assert (opt2.get_metrics_array() >= 0).all()
+
+
+def test_hparams_exist():
+    opt = wire(RandomSearch(seed=2), 3)
+    t = opt.get_suggestion()
+    opt.trial_store[t.trial_id] = t
+    assert opt.hparams_exist(t.params)
+    assert not opt.hparams_exist({"lr": 0.5, "width": 9, "act": "relu"})
