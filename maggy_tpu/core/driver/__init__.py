@@ -1,0 +1,4 @@
+from maggy_tpu.core.driver.base import Driver
+from maggy_tpu.core.driver.hpo import BaseDriver, HyperparameterOptDriver
+
+__all__ = ["Driver", "HyperparameterOptDriver", "BaseDriver"]

@@ -1,0 +1,221 @@
+"""Experiment driver base class.
+
+Template-method orchestration with the same shape as the reference's Spark/Python
+drivers (core/experiment_driver/spark_driver.py:39-287, python_driver.py:39-267):
+``run_experiment`` = startup callback → init (RPC server + digestion thread) →
+launch executors → await completion → final callback → stop.
+
+Execution substrate: instead of Spark's ``foreachPartition`` long-running tasks
+(spark_driver.py:136-145), executors are local worker threads, each leasing a
+disjoint group of accelerator devices (trial ↔ sub-slice placement). Multi-host
+pods reuse the same RPC protocol with workers connecting over the host network.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+import traceback
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Dict, List, Optional
+
+from maggy_tpu import util
+from maggy_tpu.core import rpc
+from maggy_tpu.core.env import EnvSing
+
+logger = logging.getLogger(__name__)
+
+
+def device_groups(devices_per_trial: int = 1) -> List[list]:
+    """Partition this host's accelerators into disjoint trial leases.
+
+    The TPU-native replacement for "1 Spark executor = 1 worker": a worker is a
+    device group (sub-slice), so N trials train concurrently on one host without
+    contending for chips.
+    """
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return [[]]
+    k = max(1, devices_per_trial)
+    n_groups = max(1, len(devices) // k)
+    return [devices[i * k : (i + 1) * k] for i in range(n_groups)]
+
+
+class Driver(ABC):
+    def __init__(self, config, app_id: str, run_id: int):
+        self.config = config
+        self.app_id = app_id
+        self.run_id = run_id
+        self.env = EnvSing.get_instance()
+        self.exp_dir = config.log_dir or self.env.experiment_dir(app_id, run_id)
+        self.num_executors: int = 1
+        self.server: Optional[rpc.Server] = None
+        self.result: Any = None
+        self.executor_logs: List[str] = []
+        self.exception: Optional[BaseException] = None
+        self.lock = threading.RLock()
+        self.abort = threading.Event()
+        self.experiment_done = threading.Event()
+        self._worker_threads: List[threading.Thread] = []
+        self._digestion_thread: Optional[threading.Thread] = None
+        self.job_start: Optional[float] = None
+        self.duration: Optional[float] = None
+        self._log_fd = None
+
+    # ------------------------------------------------------------------ hooks
+
+    @abstractmethod
+    def _make_server(self) -> rpc.Server:
+        ...
+
+    @abstractmethod
+    def _register_msg_callbacks(self) -> None:
+        ...
+
+    @abstractmethod
+    def _executor_fn(self, train_fn: Callable, partition_id: int, devices: list) -> Callable:
+        """Return the zero-arg callable that runs one worker's loop."""
+
+    def _exp_startup_callback(self) -> None:
+        ...
+
+    def _exp_final_callback(self) -> None:
+        ...
+
+    def _handle_message(self, msg: Dict[str, Any]) -> None:
+        """Digestion-thread message handling; override per driver."""
+
+    def _on_tick(self) -> None:
+        """Digestion-thread periodic hook (assignment retries, early-stop sweeps)."""
+
+    # ------------------------------------------------------------------ template
+
+    def run_experiment(self, train_fn: Callable) -> Any:
+        self.job_start = time.time()
+        self._open_log()
+        self.log(
+            f"Starting experiment {self.config.name} "
+            f"({type(self).__name__}, {self.num_executors} executors)"
+        )
+        try:
+            self._exp_startup_callback()
+            self.init()
+            self._launch_executors(train_fn)
+            self._await_completion()
+            if self.exception is not None:
+                raise self.exception
+            self._exp_final_callback()
+            self.duration = time.time() - self.job_start
+            return self.result
+        finally:
+            self.stop()
+
+    def init(self) -> None:
+        self.server = self._make_server()
+        self._register_msg_callbacks()
+        self.server.start()
+        self._digestion_thread = threading.Thread(
+            target=self._digest_loop, name="maggy-digestion", daemon=True
+        )
+        self._digestion_thread.start()
+
+    def _launch_executors(self, train_fn: Callable) -> None:
+        groups = self._device_groups()
+        for pid in range(self.num_executors):
+            devices = groups[pid % len(groups)] if groups else []
+            fn = self._executor_fn(train_fn, pid, devices)
+            t = threading.Thread(
+                target=self._worker_wrapper, args=(fn, pid),
+                name=f"maggy-executor-{pid}", daemon=True,
+            )
+            self._worker_threads.append(t)
+            t.start()
+
+    def _device_groups(self) -> List[list]:
+        return device_groups(getattr(self.config, "devices_per_trial", 1))
+
+    def _worker_wrapper(self, fn: Callable, partition_id: int) -> None:
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - worker death aborts the experiment
+            with self.lock:
+                if self.exception is None:
+                    self.exception = e
+            self.log(
+                f"Executor {partition_id} died: {e}\n{traceback.format_exc()}"
+            )
+            self.abort.set()
+            self.experiment_done.set()
+
+    def _await_completion(self) -> None:
+        for t in self._worker_threads:
+            while t.is_alive():
+                t.join(timeout=0.5)
+                if self.abort.is_set():
+                    # give workers a grace period to see GSTOP, then move on
+                    t.join(timeout=5)
+                    break
+
+    def _digest_loop(self) -> None:
+        while not self.experiment_done.is_set() or not self.server.message_queue.empty():
+            try:
+                msg = self.server.message_queue.get(timeout=0.1)
+            except queue.Empty:
+                msg = None
+            try:
+                if msg is not None:
+                    self._handle_message(msg)
+                self._on_tick()
+            except BaseException as e:  # noqa: BLE001 - surfaced at finalization
+                with self.lock:
+                    if self.exception is None:
+                        self.exception = e
+                self.log(f"Driver digestion error: {e}\n{traceback.format_exc()}")
+                self.abort.set()
+                self.experiment_done.set()
+                return
+
+    def stop(self) -> None:
+        self.experiment_done.set()
+        if self._digestion_thread is not None and self._digestion_thread.is_alive():
+            self._digestion_thread.join(timeout=5)
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+        if self._log_fd is not None:
+            self._log_fd.close()
+            self._log_fd = None
+
+    # ------------------------------------------------------------------ logging
+
+    def _open_log(self) -> None:
+        try:
+            self._log_fd = open(os.path.join(self.exp_dir, "maggy.log"), "a", buffering=1)
+        except OSError:
+            self._log_fd = None
+
+    def log(self, message: str) -> None:
+        line = f"[{time.strftime('%H:%M:%S')}] {message}"
+        with self.lock:
+            self.executor_logs.append(line)
+            if self._log_fd is not None:
+                self._log_fd.write(line + "\n")
+        logger.info(message)
+
+    def add_executor_logs(self, logs: List[str]) -> None:
+        with self.lock:
+            self.executor_logs.extend(logs)
+
+    def drain_logs(self) -> List[str]:
+        with self.lock:
+            out, self.executor_logs = self.executor_logs, []
+            return out
+
+    def progress(self) -> str:
+        return ""

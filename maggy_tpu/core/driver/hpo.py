@@ -1,0 +1,405 @@
+"""Hyperparameter-optimization driver — the HPO orchestrator.
+
+Capability parity with the reference ``HyperparameterOptDriver``
+(core/experiment_driver/optimization_driver.py:40-692): optimizer/early-stop
+wiring, executor cap at min(executors, trials), message callbacks for
+REG (lost-trial detection on re-registration), METRIC (early-stop sweep),
+FINAL (finalize → persist → next suggestion → assign or idle or done), periodic
+idle-assignment retries, and best/worst/avg result aggregation persisted to
+``result.json``.
+
+``BaseDriver`` (reference base_driver.py:35-258) reuses the same machinery with a
+SingleRun optimizer and one executor, returning the train_fn's outputs directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from maggy_tpu import constants, util
+from maggy_tpu.config.base import BaseConfig
+from maggy_tpu.core import rpc
+from maggy_tpu.core.driver.base import Driver, device_groups
+from maggy_tpu.core.executors.trial import trial_executor_fn
+from maggy_tpu.optimizer import IDLE, get_earlystop, get_optimizer
+from maggy_tpu.optimizer.gridsearch import GridSearch
+from maggy_tpu.trial import Trial
+
+
+class HyperparameterOptDriver(Driver):
+    def __init__(self, config, app_id: str, run_id: int):
+        super().__init__(config, app_id, run_id)
+        self.searchspace = config.searchspace
+        self.direction = config.direction
+        self.optimization_key = config.optimization_key
+
+        self.trial_store: Dict[str, Trial] = {}
+        self.final_store: List[Trial] = []
+
+        # pruner (optional) — wired before the optimizer so it can override
+        # num_trials (reference optimization_driver.py:88-89)
+        self.pruner = self._make_pruner(config)
+        num_trials = config.num_trials
+        if self.pruner is not None:
+            num_trials = self.pruner.num_trials()
+        if isinstance(config.optimizer, str) and config.optimizer.lower() in (
+            "gridsearch",
+            "grid",
+        ):
+            num_trials = GridSearch.get_num_trials(config.searchspace)
+        self.num_trials = num_trials
+
+        self.controller = get_optimizer(config.optimizer, seed=config.seed)
+        self.controller.setup(
+            config.searchspace,
+            self.num_trials,
+            self.trial_store,
+            self.final_store,
+            direction=config.direction,
+            pruner=self.pruner,
+        )
+        self.earlystop = get_earlystop(config.es_policy)
+        self._es_last_check = time.time()
+        self._optimizer_exhausted = False
+        self._maybe_idle: set = set()
+
+        groups = device_groups(config.devices_per_trial)
+        self.num_executors = max(
+            1, min(config.num_executors or len(groups), self.num_trials)
+        )
+
+    def _make_pruner(self, config):
+        if config.pruner is None:
+            return None
+        if isinstance(config.pruner, str):
+            if config.pruner.lower() == "hyperband":
+                try:
+                    from maggy_tpu.pruner.hyperband import Hyperband
+                except ImportError as e:
+                    raise NotImplementedError(
+                        f"The hyperband pruner requires the pruner module: {e}"
+                    ) from e
+                return Hyperband(
+                    trial_metric_getter=self._trial_metric_getter,
+                    **config.pruner_config,
+                )
+            raise ValueError(f"Unknown pruner {config.pruner!r}")
+        return config.pruner
+
+    def _trial_metric_getter(self, trial_ids):
+        """Lookup final metrics by trial id for the pruner (reference pruner
+        callbacks)."""
+        if isinstance(trial_ids, str):
+            trial_ids = [trial_ids]
+        out = {}
+        with self.lock:
+            for t in self.final_store:
+                if t.trial_id in trial_ids:
+                    out[t.trial_id] = t.final_metric
+        return out
+
+    # ------------------------------------------------------------------ server
+
+    def _make_server(self) -> rpc.Server:
+        return rpc.Server(self.num_executors)
+
+    def _register_msg_callbacks(self) -> None:
+        s = self.server
+        s.register_callback("REG", self._reg_callback)
+        s.register_callback("QUERY", lambda m: {"type": "QUERY", "ready": s.reservations.done()})
+        s.register_callback("GET", self._get_callback)
+        s.register_callback("METRIC", self._metric_callback)
+        s.register_callback("FINAL", self._final_callback)
+        s.register_callback("LOG", self._log_callback)
+
+    # --- event-loop handlers: fast, lock briefly, enqueue heavy work ----------
+
+    def _reg_callback(self, msg) -> Dict[str, Any]:
+        reregistered = self.server.reservations.register(
+            msg["partition_id"], msg.get("meta", {})
+        )
+        self.server.enqueue({**msg, "reregistered": reregistered})
+        return {"type": "OK"}
+
+    def _get_callback(self, msg) -> Dict[str, Any]:
+        pid = msg["partition_id"]
+        assignment = self.server.reservations.get_assignment(pid)
+        if assignment is not None:
+            with self.lock:
+                trial = self.trial_store.get(assignment)
+            if trial is not None:
+                return {"type": "TRIAL", "trial_id": trial.trial_id, "params": trial.params}
+        if self.experiment_done.is_set() or self.abort.is_set():
+            return {"type": "GSTOP"}
+        return {"type": "IDLE"}
+
+    def _metric_callback(self, msg) -> Dict[str, Any]:
+        self.server.enqueue(msg)
+        if self.abort.is_set():
+            # interrupt every broadcasting train_fn so aborted experiments do not
+            # leave workers training on leased devices
+            return {"type": "STOP"}
+        trial_id = msg.get("trial_id")
+        if trial_id:
+            with self.lock:
+                trial = self.trial_store.get(trial_id)
+            if trial is not None and trial.get_early_stop():
+                return {"type": "STOP"}
+        return {"type": "OK"}
+
+    def _final_callback(self, msg) -> Dict[str, Any]:
+        self.server.enqueue(msg)
+        return {"type": "OK"}
+
+    def _log_callback(self, msg) -> Dict[str, Any]:
+        return {"type": "LOG", "logs": self.drain_logs(), "progress": self.progress()}
+
+    # ------------------------------------------------ digestion-thread handlers
+
+    def _handle_message(self, msg: Dict[str, Any]) -> None:
+        verb = msg.get("type")
+        if verb == "REG":
+            self._digest_reg(msg)
+        elif verb == "METRIC":
+            self._digest_metric(msg)
+        elif verb == "FINAL":
+            self._digest_final(msg)
+
+    def _digest_reg(self, msg) -> None:
+        pid = msg["partition_id"]
+        if msg.get("reregistered"):
+            # worker restarted: its in-flight trial is lost
+            # (reference rpc.py:415-437 -> optimization_driver.py:473-483)
+            assignment = self.server.reservations.get_assignment(pid)
+            if assignment is not None:
+                with self.lock:
+                    lost = self.trial_store.pop(assignment, None)
+                    if lost is not None:
+                        lost.error()
+                        self.final_store.append(lost)
+                if lost is not None:
+                    self._persist_trial(lost)
+                    self.log(f"Trial {assignment} lost with executor {pid}; marked ERROR")
+                self.server.reservations.assign_trial(pid, None)
+        self._try_assign(pid)
+
+    def _digest_metric(self, msg) -> None:
+        trial_id, metric, step = msg.get("trial_id"), msg.get("metric"), msg.get("step")
+        logs = msg.get("logs") or []
+        if logs:
+            self.add_executor_logs(logs)
+        if trial_id and metric is not None:
+            with self.lock:
+                trial = self.trial_store.get(trial_id)
+            if trial is not None:
+                if trial.status != Trial.RUNNING:
+                    trial.begin()
+                trial.append_metric(metric, step if step is not None and step >= 0 else None)
+        self._earlystop_sweep()
+
+    def _earlystop_sweep(self) -> None:
+        """Reference optimization_driver.py:433-471: run the early-stop policy
+        every es_interval seconds once es_min trials have finalized."""
+        cfg = self.config
+        if time.time() - self._es_last_check < cfg.es_interval:
+            return
+        self._es_last_check = time.time()
+        with self.lock:
+            if len(self.final_store) < cfg.es_min:
+                return
+            to_check = {
+                tid: t for tid, t in self.trial_store.items() if t.metric_history
+            }
+            final = list(self.final_store)
+        for tid in self.earlystop.earlystop_check(to_check, final, self.direction):
+            with self.lock:
+                trial = self.trial_store.get(tid)
+            if trial is not None and not trial.get_early_stop():
+                trial.set_early_stop()
+                self.log(f"Early stopping trial {tid}")
+
+    def _digest_final(self, msg) -> None:
+        pid = msg["partition_id"]
+        trial_id = msg["trial_id"]
+        with self.lock:
+            trial = self.trial_store.pop(trial_id, None)
+        if trial is None:
+            return
+        if msg.get("error"):
+            trial.error()
+            self.log(f"Trial {trial_id} errored: {msg['error']}")
+            with self.lock:
+                had_success = any(t.status == Trial.FINALIZED for t in self.final_store)
+            if not had_success:
+                # fail fast when nothing has ever succeeded — a broken train_fn
+                # should not burn the whole trial budget
+                raise RuntimeError(
+                    f"First trial(s) failed with: {msg['error']} — aborting experiment."
+                )
+        else:
+            trial.finalize(msg.get("metric"))
+            trial.info_dict["outputs"] = msg.get("outputs") or {}
+            if msg.get("early_stopped"):
+                trial.info_dict["early_stopped"] = True
+        with self.lock:
+            self.final_store.append(trial)
+        self._persist_trial(trial)
+        self.server.reservations.assign_trial(pid, None)
+        self.log(
+            f"Trial {trial_id} {trial.status} metric={trial.final_metric} "
+            f"({len(self.final_store)} done)"
+        )
+        self._try_assign(pid)
+
+    def _on_tick(self) -> None:
+        # retry partitions that previously got IDLE (reference
+        # optimization_driver.py:542-568 debounced retries)
+        for pid in list(self._maybe_idle):
+            self._try_assign(pid)
+
+    def _try_assign(self, pid: int) -> None:
+        if self.experiment_done.is_set():
+            return
+        if self.server.reservations.get_assignment(pid) is not None:
+            return
+        with self.lock:
+            finished = self.final_store[-1] if self.final_store else None
+        suggestion = self.controller.get_suggestion(finished)
+        if isinstance(suggestion, Trial):
+            suggestion.schedule(pid)
+            with self.lock:
+                self.trial_store[suggestion.trial_id] = suggestion
+            self.server.reservations.assign_trial(pid, suggestion.trial_id)
+            self._maybe_idle.discard(pid)
+        elif suggestion == IDLE:
+            self._maybe_idle.add(pid)
+        else:  # None: optimizer exhausted
+            self._optimizer_exhausted = True
+            self._maybe_idle.discard(pid)
+            with self.lock:
+                in_flight = len(self.trial_store)
+            if in_flight == 0:
+                self._finish_experiment()
+
+    def _finish_experiment(self) -> None:
+        self._update_result()
+        self.experiment_done.set()
+
+    # ------------------------------------------------------------------ results
+
+    def _update_result(self) -> None:
+        with self.lock:
+            done = [t for t in self.final_store if t.final_metric is not None]
+            errors = [t for t in self.final_store if t.status == Trial.ERROR]
+            stopped = [t for t in self.final_store if t.info_dict.get("early_stopped")]
+        if not done:
+            self.result = {"num_trials": len(self.final_store), "best": None}
+            return
+        reverse = self.direction == "max"
+        ranked = sorted(done, key=lambda t: t.final_metric, reverse=reverse)
+        best, worst = ranked[0], ranked[-1]
+        self.result = {
+            "best": {
+                "trial_id": best.trial_id,
+                "params": best.params,
+                self.optimization_key: best.final_metric,
+                "outputs": best.info_dict.get("outputs", {}),
+            },
+            "worst": {
+                "trial_id": worst.trial_id,
+                "params": worst.params,
+                self.optimization_key: worst.final_metric,
+            },
+            "avg": statistics.mean(t.final_metric for t in done),
+            "num_trials": len(self.final_store),
+            "early_stopped": len(stopped),
+            "errors": len(errors),
+            "duration": time.time() - self.job_start if self.job_start else None,
+        }
+
+    def _persist_trial(self, trial: Trial) -> None:
+        try:
+            d = self.env.trial_dir(self.app_id, self.run_id, trial.trial_id)
+            self.env.dump(trial.to_dict(), os.path.join(d, constants.TRIAL_FILE))
+        except OSError as e:
+            self.log(f"Could not persist trial {trial.trial_id}: {e}")
+
+    def _exp_final_callback(self) -> None:
+        self._update_result()
+        try:
+            self.env.dump(
+                util._jsonify(self.result),
+                os.path.join(self.exp_dir, constants.RESULT_FILE),
+            )
+            self.env.dump(
+                {
+                    "name": self.config.name,
+                    "app_id": self.app_id,
+                    "run_id": self.run_id,
+                    "num_trials": self.num_trials,
+                    "direction": self.direction,
+                    "optimizer": self.controller.name(),
+                    "duration": time.time() - self.job_start if self.job_start else None,
+                },
+                os.path.join(self.exp_dir, constants.EXPERIMENT_FILE),
+            )
+        except OSError as e:
+            self.log(f"Could not persist experiment result: {e}")
+        self.controller.finalize_experiment(self.final_store)
+
+    def progress(self) -> str:
+        with self.lock:
+            return util.progress_bar(len(self.final_store), self.num_trials)
+
+    # ------------------------------------------------------------------ executor
+
+    def _executor_fn(self, train_fn: Callable, partition_id: int, devices: list) -> Callable:
+        return trial_executor_fn(
+            train_fn=train_fn,
+            config=self.config,
+            app_id=self.app_id,
+            run_id=self.run_id,
+            partition_id=partition_id,
+            server_addr=(self.server.host, self.server.port),
+            secret=self.server.secret,
+            devices=devices,
+        )
+
+
+class BaseDriver(HyperparameterOptDriver):
+    """Single-run experiment (reference base_driver.py:35-258): run the train_fn
+    once under full experiment bookkeeping and return its outputs."""
+
+    def __init__(self, config: BaseConfig, app_id: str, run_id: int):
+        from maggy_tpu.config.hpo import HyperparameterOptConfig
+        from maggy_tpu.searchspace import Searchspace
+
+        hpo_config = HyperparameterOptConfig(
+            num_trials=1,
+            optimizer="none",
+            searchspace=Searchspace(),
+            optimization_key="metric",
+            es_policy="none",
+            es_min=2**31,
+            name=config.name,
+            description=config.description,
+            hb_interval=config.hb_interval,
+            model=config.model,
+            dataset=config.dataset,
+            num_executors=1,
+            log_dir=config.log_dir,
+        )
+        hpo_config.hparams = config.hparams
+        super().__init__(hpo_config, app_id, run_id)
+
+    def _exp_final_callback(self) -> None:
+        super()._exp_final_callback()
+        best = (self.result or {}).get("best") or {}
+        outputs = best.get("outputs") or {}
+        # return the train_fn's own outputs, like the reference BaseDriver
+        # (base_driver.py:221-242)
+        self.result = outputs if outputs else self.result

@@ -1,0 +1,119 @@
+"""Trial executor — the worker-side loop for HPO/ablation/single-run experiments.
+
+Capability parity with the reference ``trial_executor_fn``
+(core/executors/trial_executor.py:35-213): register → heartbeat → loop
+{blocking get_suggestion → per-trial logdir + .hparams.json → signature-based
+kwarg injection → train_fn → normalize return value → finalize_metric} until
+GSTOP. Early stops arrive as EarlyStopException out of ``reporter.broadcast``
+and keep the last metric (trial_executor.py:194-196).
+
+TPU-native differences: the worker holds a lease on a disjoint device group
+(passed as the ``devices`` kwarg, usable as ``jax.jit(..., device=devices[0])``
+or a sub-mesh); train_fn errors are reported to the driver as errored trials
+instead of killing a Spark task.
+"""
+
+from __future__ import annotations
+
+import os
+import socket as socket_mod
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from maggy_tpu import constants, util
+from maggy_tpu.core import rpc
+from maggy_tpu.core.env import EnvSing
+from maggy_tpu.exceptions import EarlyStopException
+from maggy_tpu.reporter import Reporter
+
+# keys stripped from trial params before they reach the train_fn as hparams
+_CONTROL_KEYS = ("run",)
+
+
+def trial_executor_fn(
+    train_fn: Callable,
+    config,
+    app_id: str,
+    run_id: int,
+    partition_id: int,
+    server_addr,
+    secret: str,
+    devices: Optional[list] = None,
+) -> Callable[[], None]:
+    def _executor() -> None:
+        env = EnvSing.get_instance()
+        exp_dir = env.experiment_dir(app_id, run_id)
+        log_file = os.path.join(exp_dir, f"executor_{partition_id}.log")
+        reporter = Reporter(log_file=log_file, partition_id=partition_id)
+        client = rpc.Client(
+            server_addr, partition_id, secret, hb_interval=config.hb_interval
+        )
+        try:
+            client.register(
+                meta={
+                    "host": socket_mod.gethostname(),
+                    "devices": [str(d) for d in (devices or [])],
+                }
+            )
+            client.start_heartbeat(reporter)
+            while True:
+                reply = client.get_suggestion()
+                if reply["type"] == "GSTOP":
+                    break
+                _run_trial(reply, client, reporter, env)
+        finally:
+            client.stop()
+            reporter.close()
+
+    def _run_trial(reply: Dict[str, Any], client: rpc.Client, reporter: Reporter, env) -> None:
+        trial_id, params = reply["trial_id"], dict(reply["params"])
+        reporter.reset(trial_id)
+        trial_dir = env.trial_dir(app_id, run_id, trial_id)
+        try:
+            env.dump(util._jsonify(params), os.path.join(trial_dir, constants.HPARAMS_FILE))
+        except OSError:
+            pass
+
+        hparams = {
+            **dict(getattr(config, "hparams", None) or {}),
+            **{k: v for k, v in params.items() if k not in _CONTROL_KEYS},
+        }
+        available = {
+            "hparams": hparams,
+            "reporter": reporter,
+            "model": getattr(config, "model", None),
+            "dataset": getattr(config, "dataset", None),
+            "devices": devices,
+            "trial_dir": trial_dir,
+            "budget": params.get("budget"),
+        }
+        kwargs = util.inject_kwargs(train_fn, available)
+
+        metric: Optional[float] = None
+        outputs: Dict[str, Any] = {}
+        error: Optional[str] = None
+        early = False
+        try:
+            retval = train_fn(**kwargs)
+            metric = util.handle_return_val(
+                retval, trial_dir, config.optimization_key
+            )
+            outputs = retval if isinstance(retval, dict) else {config.optimization_key: metric}
+        except EarlyStopException as e:
+            early = True
+            metric = e.metric if e.metric is not None else reporter.get_metric()
+            outputs = {config.optimization_key: metric}
+            reporter.log(f"Trial {trial_id} early-stopped at metric {metric}")
+        except Exception as e:  # noqa: BLE001 - errored trial, not a dead worker
+            error = f"{type(e).__name__}: {e}"
+            reporter.log(f"Trial {trial_id} failed:\n{traceback.format_exc()}")
+
+        client.finalize_metric(
+            trial_id,
+            metric,
+            outputs=util._jsonify(outputs),
+            error=error,
+            early_stopped=early,
+        )
+
+    return _executor

@@ -1,0 +1,448 @@
+"""Control-plane RPC: driver <-> executor messaging.
+
+This is the entire control plane, the analogue of the reference's
+``maggy/core/rpc.py`` (§2.4 of SURVEY.md) with the same verb set —
+REG / QUERY / METRIC / FINAL / GET / LOG / EXEC_CONFIG / RESERVATIONS — but a
+different transport design:
+
+* **Framing:** 4-byte big-endian length + UTF-8 JSON. The reference frames
+  cloudpickle (rpc.py:205-257); JSON removes arbitrary-code-execution risk from
+  the wire and keeps messages debuggable. Functions are never shipped over this
+  channel — workers receive the train_fn in-process (threads) or at launch.
+* **Server:** one asyncio event loop on a daemon thread (replacing the reference's
+  select() loop, rpc.py:350-381). Handlers must be non-blocking: they read
+  thread-safe shared stores and enqueue heavy work for the driver's digestion
+  thread — the socket loop never waits on an optimizer.
+* **Auth:** every message carries the experiment secret, checked with
+  ``secrets.compare_digest`` (reference rpc.py:366-375).
+
+The client is synchronous (worker loops are plain Python), with a main socket and
+a separate heartbeat socket so the heartbeat thread never interleaves frames with
+the trial loop (reference rpc.py:647-651).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import secrets as secrets_mod
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from maggy_tpu import constants
+from maggy_tpu.exceptions import (
+    ReservationTimeoutError,
+    RpcError,
+)
+
+_LEN = struct.Struct(">I")
+
+
+# --------------------------------------------------------------------------- framing
+
+
+def send_frame(sock: socket.socket, payload: Dict[str, Any]) -> None:
+    data = json.dumps(payload, separators=(",", ":"), default=str).encode("utf-8")
+    if len(data) > constants.RPC_MAX_MESSAGE:
+        raise RpcError(f"Message of {len(data)} bytes exceeds frame cap")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> Dict[str, Any]:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > constants.RPC_MAX_MESSAGE:
+        raise RpcError(f"Incoming frame of {length} bytes exceeds cap")
+    return json.loads(_recv_exact(sock, length).decode("utf-8"))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(constants.RPC_BUFSIZE, n - len(buf)))
+        if not chunk:
+            raise RpcError("Connection closed by peer")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+# ----------------------------------------------------------------------- reservations
+
+
+class Reservations:
+    """Thread-safe registry: partition_id -> registration + current trial assignment.
+
+    The driver's scheduling substrate (reference rpc.py:45-123): the digestion
+    thread writes assignments, the server's GET handler reads them.
+    """
+
+    def __init__(self, required: int):
+        self.required = required
+        self._lock = threading.RLock()
+        self._entries: Dict[int, Dict[str, Any]] = {}
+        self._assignments: Dict[int, Optional[str]] = {}
+
+    def register(self, partition_id: int, meta: Dict[str, Any]) -> bool:
+        """Returns True if a *different* worker instance had already registered this
+        partition (re-registration = restarted worker; triggers lost-trial handling,
+        reference rpc.py:415-437). A retried REG from the same instance carries the
+        same ``attempt`` nonce and is idempotent — a lost reply must not look like
+        a worker restart."""
+        with self._lock:
+            prev = self._entries.get(partition_id)
+            restarted = prev is not None and prev.get("attempt") != meta.get("attempt")
+            self._entries[partition_id] = dict(meta)
+            if prev is None:
+                self._assignments.setdefault(partition_id, None)
+            return restarted
+
+    def done(self) -> bool:
+        with self._lock:
+            return len(self._entries) >= self.required
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def assign_trial(self, partition_id: int, trial_id: Optional[str]) -> None:
+        with self._lock:
+            self._assignments[partition_id] = trial_id
+
+    def get_assignment(self, partition_id: int) -> Optional[str]:
+        with self._lock:
+            return self._assignments.get(partition_id)
+
+    def get_assignments(self) -> Dict[int, Optional[str]]:
+        with self._lock:
+            return dict(self._assignments)
+
+    def cluster_spec(self) -> List[Dict[str, Any]]:
+        """All registrations ordered by partition id — the EXEC_CONFIG payload that
+        lets rank 0 become the coordinator (reference rpc.py:544-553)."""
+        with self._lock:
+            return [
+                {"partition_id": pid, **self._entries[pid]}
+                for pid in sorted(self._entries)
+            ]
+
+
+# ---------------------------------------------------------------------------- server
+
+
+class Server:
+    """Asyncio TCP control-plane server owned by the experiment driver.
+
+    ``callbacks`` maps verb -> handler(msg_dict) -> reply_dict. Handlers run on
+    the event loop and must not block; anything heavy goes through
+    ``message_queue`` to the driver's digestion thread.
+    """
+
+    def __init__(self, num_executors: int, secret: Optional[str] = None):
+        self.reservations = Reservations(num_executors)
+        self.secret = secret or secrets_mod.token_hex(16)
+        self.message_queue: "queue.Queue[Dict[str, Any]]" = queue.Queue()
+        self.callbacks: Dict[str, Callable[[Dict[str, Any]], Dict[str, Any]]] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = threading.Event()
+        self.host = "127.0.0.1"
+        self.port = 0
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def start(self, host: str = "0.0.0.0", port: int = 0) -> Tuple[str, int]:
+        self._thread = threading.Thread(
+            target=self._run_loop, args=(host, port), name="maggy-rpc-server", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RpcError("RPC server failed to start within 10s")
+        return self.host, self.port
+
+    def _run_loop(self, host: str, port: int) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _main():
+            self._server = await asyncio.start_server(self._handle_client, host, port)
+            sockname = self._server.sockets[0].getsockname()
+            self.host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+            self.port = sockname[1]
+            self._started.set()
+            async with self._server:
+                await self._server.serve_forever()
+
+        try:
+            self._loop.run_until_complete(_main())
+        except asyncio.CancelledError:
+            pass
+        finally:
+            try:
+                pending = asyncio.all_tasks(self._loop)
+                for t in pending:
+                    t.cancel()
+                self._loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+            finally:
+                self._loop.close()
+
+    def stop(self) -> None:
+        if self._loop and self._loop.is_running():
+
+            def _shutdown():
+                for task in asyncio.all_tasks(self._loop):
+                    task.cancel()
+
+            self._loop.call_soon_threadsafe(_shutdown)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------------ handling
+
+    async def _handle_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                header = await reader.readexactly(_LEN.size)
+                (length,) = _LEN.unpack(header)
+                if length > constants.RPC_MAX_MESSAGE:
+                    break
+                msg = json.loads((await reader.readexactly(length)).decode("utf-8"))
+                reply = self._dispatch(msg)
+                data = json.dumps(reply, separators=(",", ":"), default=str).encode()
+                writer.write(_LEN.pack(len(data)) + data)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError, json.JSONDecodeError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def _dispatch(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        if not secrets_mod.compare_digest(str(msg.get("secret", "")), self.secret):
+            return {"type": "ERR", "error": "bad secret"}
+        verb = msg.get("type", "")
+        handler = self.callbacks.get(verb)
+        if handler is None:
+            return {"type": "ERR", "error": f"unknown verb {verb!r}"}
+        try:
+            reply = handler(msg)
+        except Exception as e:  # handler bugs must not kill the socket loop
+            return {"type": "ERR", "error": f"{type(e).__name__}: {e}"}
+        return reply if reply is not None else {"type": "OK"}
+
+    # ------------------------------------------------------------------ helpers
+
+    def register_callback(self, verb: str, handler) -> None:
+        self.callbacks[verb] = handler
+
+    def enqueue(self, msg: Dict[str, Any]) -> None:
+        self.message_queue.put(msg)
+
+    def await_reservations(
+        self, timeout: float = constants.RESERVATION_TIMEOUT, abort: Optional[threading.Event] = None
+    ) -> None:
+        """Block until all executors registered (reference rpc.py:282-305)."""
+        deadline = time.time() + timeout
+        while not self.reservations.done():
+            if abort is not None and abort.is_set():
+                raise RpcError("Experiment aborted while awaiting reservations")
+            if time.time() > deadline:
+                raise ReservationTimeoutError(
+                    self.reservations.count(), self.reservations.required, timeout
+                )
+            time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------- client
+
+
+class Client:
+    """Synchronous worker-side client (reference rpc.py:636-802).
+
+    Two sockets: the main socket serves the trial loop (register / GET / FINAL);
+    the heartbeat socket belongs to the heartbeat thread, which drains the
+    reporter every ``hb_interval`` seconds, sends METRIC, and flips the
+    reporter's early-stop flag when the driver replies STOP.
+    """
+
+    def __init__(
+        self,
+        server_addr: Tuple[str, int],
+        partition_id: int,
+        secret: str,
+        hb_interval: float = 1.0,
+    ):
+        self.server_addr = tuple(server_addr)
+        self.partition_id = partition_id
+        self.secret = secret
+        self.hb_interval = hb_interval
+        # one nonce per client instance: lets the server tell a retried REG
+        # (same nonce) from a restarted worker (new nonce)
+        self.attempt_id = secrets_mod.token_hex(8)
+        self._main_sock = self._connect()
+        self._main_lock = threading.Lock()
+        self._hb_sock: Optional[socket.socket] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+
+    def _connect(self) -> socket.socket:
+        last_err = None
+        for _ in range(constants.RPC_MAX_RETRIES):
+            try:
+                sock = socket.create_connection(self.server_addr, timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return sock
+            except OSError as e:
+                last_err = e
+                time.sleep(0.2)
+        raise RpcError(f"Could not connect to driver at {self.server_addr}: {last_err}")
+
+    def _request(self, msg: Dict[str, Any], heartbeat: bool = False) -> Dict[str, Any]:
+        """Send one frame and read the reply, reconnecting up to MAX_RETRIES
+        (reference rpc.py:660-688)."""
+        msg = {**msg, "secret": self.secret, "partition_id": self.partition_id}
+        last_err: Optional[Exception] = None
+        for attempt in range(constants.RPC_MAX_RETRIES):
+            try:
+                if heartbeat:
+                    send_frame(self._hb_sock, msg)
+                    reply = recv_frame(self._hb_sock)
+                else:
+                    with self._main_lock:
+                        send_frame(self._main_sock, msg)
+                        reply = recv_frame(self._main_sock)
+                if reply.get("type") == "ERR":
+                    raise RpcError(f"Driver rejected message: {reply.get('error')}")
+                return reply
+            except (OSError, RpcError) as e:
+                if isinstance(e, RpcError) and "rejected" in str(e):
+                    raise
+                last_err = e
+                time.sleep(0.2 * (attempt + 1))
+                try:
+                    if heartbeat:
+                        self._hb_sock.close()
+                        self._hb_sock = self._connect()
+                    else:
+                        with self._main_lock:
+                            self._main_sock.close()
+                            self._main_sock = self._connect()
+                except RpcError:
+                    pass
+        raise RpcError(f"Request {msg.get('type')} failed after retries: {last_err}")
+
+    # ------------------------------------------------------------------ verbs
+
+    def register(self, meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        return self._request(
+            {"type": "REG", "meta": {**(meta or {}), "attempt": self.attempt_id}}
+        )
+
+    def await_reservations(
+        self, timeout: float = constants.RESERVATION_TIMEOUT
+    ) -> None:
+        deadline = time.time() + timeout
+        while True:
+            reply = self._request({"type": "QUERY"})
+            if reply.get("ready"):
+                return
+            if time.time() > deadline:
+                raise RpcError("Timed out waiting for all executors to register")
+            time.sleep(constants.POLL_INTERVAL)
+
+    def get_suggestion(self, poll: float = constants.POLL_INTERVAL) -> Dict[str, Any]:
+        """Blocking poll for the next trial; returns the TRIAL or GSTOP reply
+        (reference rpc.py:739-748)."""
+        while True:
+            reply = self._request({"type": "GET"})
+            if reply.get("type") in ("TRIAL", "GSTOP"):
+                return reply
+            time.sleep(poll)
+
+    def finalize_metric(
+        self,
+        trial_id: str,
+        metric: Optional[float],
+        outputs: Optional[Dict[str, Any]] = None,
+        error: Optional[str] = None,
+        early_stopped: bool = False,
+    ) -> None:
+        self._request(
+            {
+                "type": "FINAL",
+                "trial_id": trial_id,
+                "metric": metric,
+                "outputs": outputs or {},
+                "error": error,
+                "early_stopped": early_stopped,
+            }
+        )
+
+    def get_message(self, verb: str, timeout: float = 60.0) -> Dict[str, Any]:
+        """Generic typed fetch with timeout (reference rpc.py:750-762)."""
+        deadline = time.time() + timeout
+        while True:
+            reply = self._request({"type": verb})
+            if reply.get("type") == verb:
+                return reply
+            if time.time() > deadline:
+                raise RpcError(f"No {verb} reply within {timeout}s")
+            time.sleep(constants.POLL_INTERVAL)
+
+    # ------------------------------------------------------------------ heartbeat
+
+    def start_heartbeat(self, reporter) -> None:
+        self._hb_sock = self._connect()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            args=(reporter,),
+            name=f"maggy-heartbeat-{self.partition_id}",
+            daemon=True,
+        )
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self, reporter) -> None:
+        """Reference rpc.py:716-737: drain reporter -> METRIC -> handle STOP reply."""
+        while not self._hb_stop.wait(self.hb_interval):
+            self._send_beat(reporter)
+        self._send_beat(reporter)  # final flush so no metrics/logs are lost
+
+    def _send_beat(self, reporter) -> None:
+        trial_id, metric, step, logs = reporter.get_data()
+        try:
+            reply = self._request(
+                {
+                    "type": "METRIC",
+                    "trial_id": trial_id,
+                    "metric": metric,
+                    "step": step,
+                    "logs": logs,
+                },
+                heartbeat=True,
+            )
+        except RpcError:
+            return  # skip this beat; next one reconnects
+        if reply.get("type") == "STOP":
+            reporter.early_stop()
+
+    def stop(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=2 * self.hb_interval + 5)
+        for sock in (self._hb_sock, self._main_sock):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass

@@ -1,0 +1,103 @@
+"""Experiment front door: ``experiment.lagom(train_fn, config)``.
+
+Parity with the reference's lagom dispatch (experiment/experiment.py:21-45,
+experiment_python.py:48-197): a single-experiment-at-a-time guard, app/run-id
+bookkeeping, and driver selection by singledispatch on the config type. There is
+no Spark/Python backend fork — the TPU build has one execution substrate with
+local (threads) and pod (multi-host RPC) worker placement chosen by the driver.
+
+"Lagom" (Swedish): not too little, not too much — the reference's name for
+running experiments with just the right amount of resources.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+import traceback
+from functools import singledispatch
+from typing import Any, Callable, Optional
+
+from maggy_tpu import util
+from maggy_tpu.config import (
+    AblationConfig,
+    BaseConfig,
+    DistributedConfig,
+    HyperparameterOptConfig,
+)
+from maggy_tpu.config.base import LagomConfig
+
+APP_ID: Optional[str] = None
+RUN_ID: int = 0
+_running_lock = threading.Lock()
+_running = False
+
+
+def lagom(train_fn: Callable, config: LagomConfig) -> Any:
+    """Launch an experiment and block until its result is available.
+
+    :param train_fn: the oblivious training function.
+    :param config: a LagomConfig subclass instance selecting the experiment kind.
+    :returns: experiment result — best/worst/avg dict for HPO, the train_fn
+        outputs for single runs, per-worker results for distributed training.
+    """
+    global APP_ID, RUN_ID, _running
+    with _running_lock:
+        if _running:
+            raise RuntimeError(
+                "An experiment is already running; maggy runs one experiment "
+                "at a time (reference experiment_pyspark.py:43-64 guard)."
+            )
+        _running = True
+    try:
+        if APP_ID is None:
+            APP_ID = util.new_app_id()
+        RUN_ID = util.RUNS.next_run_id(APP_ID)
+        driver = lagom_driver(config, APP_ID, RUN_ID)
+        return driver.run_experiment(train_fn)
+    finally:
+        with _running_lock:
+            _running = False
+
+
+@singledispatch
+def lagom_driver(config, app_id: str, run_id: int):
+    raise TypeError(
+        f"Unsupported config type {type(config).__name__}; expected a "
+        "LagomConfig subclass (BaseConfig, HyperparameterOptConfig, "
+        "AblationConfig, DistributedConfig)."
+    )
+
+
+@lagom_driver.register(BaseConfig)
+def _(config: BaseConfig, app_id: str, run_id: int):
+    from maggy_tpu.core.driver.hpo import BaseDriver
+
+    return BaseDriver(config, app_id, run_id)
+
+
+@lagom_driver.register(HyperparameterOptConfig)
+def _(config: HyperparameterOptConfig, app_id: str, run_id: int):
+    from maggy_tpu.core.driver.hpo import HyperparameterOptDriver
+
+    return HyperparameterOptDriver(config, app_id, run_id)
+
+
+@lagom_driver.register(AblationConfig)
+def _(config: AblationConfig, app_id: str, run_id: int):
+    try:
+        from maggy_tpu.core.driver.ablation import AblationDriver
+    except ImportError as e:
+        raise NotImplementedError(f"Ablation driver unavailable: {e}") from e
+
+    return AblationDriver(config, app_id, run_id)
+
+
+@lagom_driver.register(DistributedConfig)
+def _(config: DistributedConfig, app_id: str, run_id: int):
+    try:
+        from maggy_tpu.core.driver.distributed import DistributedTrainingDriver
+    except ImportError as e:
+        raise NotImplementedError(f"Distributed driver unavailable: {e}") from e
+
+    return DistributedTrainingDriver(config, app_id, run_id)

@@ -60,11 +60,13 @@ class Reporter:
                 raise exceptions.EarlyStopException(metric=self._metric)
 
     def get_data(self):
-        """Drain pending logs and return ``(metric, step, logs)`` for a heartbeat
-        (reference reporter.py:137-142)."""
+        """Drain pending logs and return ``(trial_id, metric, step, logs)`` for a
+        heartbeat (reference reporter.py:137-142). One atomic read: trial_id and
+        metric/step must come from the same trial, or a beat racing a trial
+        boundary would attribute the old trial's metrics to the new one."""
         with self._lock:
             logs, self._logs = self._logs, []
-            return self._metric, self._step, logs
+            return self.trial_id, self._metric, self._step, logs
 
     def get_metric(self):
         with self._lock:

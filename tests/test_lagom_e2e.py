@@ -1,0 +1,182 @@
+"""End-to-end lagom() runs over the full stack: front door -> driver -> RPC
+server -> executor threads -> train_fn -> result aggregation. The analogue of
+the reference's only e2e test (test_randomsearch.py:67-101) with broader
+coverage: multiple executors, ASHA budgets, early stopping, errored train_fns,
+and single-run experiments."""
+
+import os
+import time
+
+import pytest
+
+from maggy_tpu import Searchspace, experiment
+from maggy_tpu.config import BaseConfig, HyperparameterOptConfig
+
+
+def space():
+    return Searchspace(x=("DOUBLE", [0.0, 1.0]), y=("DOUBLE", [0.0, 1.0]))
+
+
+def test_lagom_randomsearch_e2e(tmp_env):
+    """5-step train_fn broadcasting metrics; result must identify the best trial."""
+
+    def train(hparams, reporter):
+        base = hparams["x"] * (1 - hparams["y"])
+        for step in range(5):
+            reporter.broadcast(base + step * 0.01, step=step)
+        return base + 0.04
+
+    cfg = HyperparameterOptConfig(
+        num_trials=8,
+        optimizer="randomsearch",
+        searchspace=space(),
+        direction="max",
+        num_executors=4,
+        es_policy="none",
+        hb_interval=0.05,
+        seed=5,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] == 8
+    assert result["best"][cfg.optimization_key] >= result["worst"][cfg.optimization_key]
+    p = result["best"]["params"]
+    assert result["best"][cfg.optimization_key] == pytest.approx(
+        p["x"] * (1 - p["y"]) + 0.04
+    )
+    # experiment artifacts persisted
+    exp_dir = tmp_env.experiment_dir(experiment.APP_ID, experiment.RUN_ID)
+    assert os.path.exists(os.path.join(exp_dir, "result.json"))
+    trial_dirs = [d for d in os.listdir(exp_dir) if len(d) == 16]
+    assert len(trial_dirs) == 8
+    assert os.path.exists(os.path.join(exp_dir, trial_dirs[0], "trial.json"))
+
+
+def test_lagom_asha_e2e(tmp_env):
+    """ASHA: budget must reach the train_fn; more trials run than num_trials
+    (promotions)."""
+    budgets_seen = []
+
+    def train(hparams, budget, reporter):
+        budgets_seen.append(budget)
+        for step in range(int(budget)):
+            reporter.broadcast(hparams["x"], step=step)
+        return hparams["x"]
+
+    cfg = HyperparameterOptConfig(
+        num_trials=8,
+        optimizer="asha",
+        searchspace=space(),
+        direction="max",
+        num_executors=4,
+        es_policy="none",
+        hb_interval=0.05,
+        seed=0,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] > 8  # base rung + promotions
+    assert set(budgets_seen) == {1, 2, 4}
+
+
+def test_lagom_early_stopping(tmp_env):
+    """Bad trials must be stopped mid-flight by the median rule."""
+
+    def train(hparams, reporter):
+        quality = hparams["x"]
+        for step in range(200):
+            reporter.broadcast(quality, step=step)
+            time.sleep(0.002)
+        return quality
+
+    cfg = HyperparameterOptConfig(
+        num_trials=6,
+        optimizer="randomsearch",
+        searchspace=space(),
+        direction="max",
+        num_executors=2,
+        es_policy="median",
+        es_interval=0,  # check on every heartbeat digest
+        es_min=2,
+        hb_interval=0.02,
+        seed=11,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] == 6
+    assert result["early_stopped"] > 0
+
+
+def test_lagom_failing_train_fn_aborts(tmp_env):
+    def train(hparams):
+        raise RuntimeError("broken train fn")
+
+    cfg = HyperparameterOptConfig(
+        num_trials=4,
+        optimizer="randomsearch",
+        searchspace=space(),
+        num_executors=2,
+        es_policy="none",
+        hb_interval=0.05,
+    )
+    with pytest.raises(RuntimeError, match="broken train fn"):
+        experiment.lagom(train, cfg)
+
+
+def test_lagom_partial_failures_tolerated(tmp_env):
+    """Once successes exist, sporadic trial errors must not kill the experiment."""
+    calls = {"n": 0}
+
+    def train(hparams, reporter):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise ValueError("flaky trial")
+        return hparams["x"]
+
+    cfg = HyperparameterOptConfig(
+        num_trials=6,
+        optimizer="randomsearch",
+        searchspace=space(),
+        num_executors=1,  # deterministic ordering: first trials succeed
+        es_policy="none",
+        hb_interval=0.05,
+        seed=2,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] == 6
+    assert result["errors"] == 1
+
+
+def test_lagom_base_config_single_run(tmp_env):
+    def train(hparams, reporter):
+        reporter.broadcast(1.0, step=0)
+        return {"metric": 0.5, "note": 7}
+
+    result = experiment.lagom(train, BaseConfig(hparams={}, hb_interval=0.05))
+    assert result["metric"] == 0.5
+    assert result["note"] == 7
+
+
+def test_lagom_single_experiment_guard(tmp_env):
+    import threading
+
+    release = threading.Event()
+
+    def slow_train(hparams):
+        release.wait(5)
+        return 1.0
+
+    cfg = HyperparameterOptConfig(
+        num_trials=1,
+        optimizer="randomsearch",
+        searchspace=space(),
+        num_executors=1,
+        es_policy="none",
+        hb_interval=0.05,
+    )
+    t = threading.Thread(target=lambda: experiment.lagom(slow_train, cfg))
+    t.start()
+    time.sleep(0.3)
+    try:
+        with pytest.raises(RuntimeError, match="already running"):
+            experiment.lagom(lambda hparams: 1.0, cfg)
+    finally:
+        release.set()
+        t.join(timeout=10)

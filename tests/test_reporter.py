@@ -12,11 +12,11 @@ def test_broadcast_and_drain():
     r.broadcast(0.5)
     r.broadcast(0.6, step=5)
     r.log("hello")
-    metric, step, logs = r.get_data()
+    trial_id, metric, step, logs = r.get_data()
     assert metric == 0.6 and step == 5
     assert logs == ["hello"]
     # logs drained
-    assert r.get_data()[2] == []
+    assert r.get_data()[3] == []
 
 
 def test_broadcast_type_validation():

@@ -1,0 +1,145 @@
+"""Control-plane protocol tests with an in-process server and fake workers
+(SURVEY.md §4: "in-process fake cluster ... for driver<->worker protocol tests"
+— coverage the reference never had)."""
+
+import threading
+import time
+
+import pytest
+
+from maggy_tpu.core import rpc
+from maggy_tpu.exceptions import ReservationTimeoutError, RpcError
+from maggy_tpu.reporter import Reporter
+
+
+@pytest.fixture()
+def server():
+    s = rpc.Server(num_executors=2)
+
+    def reg(m):
+        s.reservations.register(m["partition_id"], m.get("meta", {}))
+        return {"type": "OK"}
+
+    s.register_callback("QUERY", lambda m: {"type": "QUERY", "ready": s.reservations.done()})
+    s.register_callback("REG", reg)
+    s.start(host="127.0.0.1")
+    yield s
+    s.stop()
+
+
+def client_for(server, pid=0):
+    return rpc.Client((server.host, server.port), pid, server.secret, hb_interval=0.05)
+
+
+def test_register_and_query(server):
+    c0 = client_for(server, 0)
+    assert c0.register({"host": "h0"})["type"] == "OK"
+    assert not server.reservations.done()
+    c1 = client_for(server, 1)
+    c1.register({"host": "h1"})
+    c0.await_reservations(timeout=5)
+    assert server.reservations.done()
+    spec = server.reservations.cluster_spec()
+    assert [e["partition_id"] for e in spec] == [0, 1]
+    assert spec[0]["host"] == "h0"
+    c0.stop()
+    c1.stop()
+
+
+def test_bad_secret_rejected(server):
+    bad = rpc.Client((server.host, server.port), 0, "wrong-secret")
+    with pytest.raises(RpcError, match="bad secret"):
+        bad.register()
+    bad.stop()
+
+
+def test_unknown_verb_rejected(server):
+    c = client_for(server)
+    with pytest.raises(RpcError, match="unknown verb"):
+        c._request({"type": "BOGUS"})
+    c.stop()
+
+
+def test_handler_exception_becomes_err_reply(server):
+    def boom(msg):
+        raise ValueError("kaput")
+
+    server.register_callback("BOOM", boom)
+    c = client_for(server)
+    with pytest.raises(RpcError, match="kaput"):
+        c._request({"type": "BOOM"})
+    # connection still usable afterwards
+    assert c._request({"type": "QUERY"})["type"] == "QUERY"
+    c.stop()
+
+
+def test_heartbeat_metric_and_stop(server):
+    """Full monitoring plane: heartbeat drains reporter -> METRIC -> STOP reply
+    flips the reporter's early-stop flag (reference §3.5 micro-stack)."""
+    metrics = []
+    stop_now = threading.Event()
+
+    def metric_cb(msg):
+        if msg.get("metric") is not None:
+            metrics.append((msg["metric"], msg["step"]))
+        return {"type": "STOP"} if stop_now.is_set() else {"type": "OK"}
+
+    server.register_callback("METRIC", metric_cb)
+    c = client_for(server, 0)
+    rep = Reporter()
+    rep.reset("trial-x")
+    c.start_heartbeat(rep)
+    rep.broadcast(0.7, step=3)
+    deadline = time.time() + 5
+    while not metrics and time.time() < deadline:
+        time.sleep(0.01)
+    assert metrics and metrics[-1][0] == 0.7 and metrics[-1][1] == 3
+
+    stop_now.set()
+    from maggy_tpu.exceptions import EarlyStopException
+
+    step = 4
+    deadline = time.time() + 5
+    stopped = False
+    while time.time() < deadline:
+        try:
+            rep.broadcast(0.9, step=step)
+        except EarlyStopException:
+            stopped = True
+            break
+        step += 1
+        time.sleep(0.05)
+    assert stopped, "early stop never propagated through heartbeat"
+    c.stop()
+
+
+def test_heartbeat_final_flush(server):
+    """Client.stop() sends one last beat so trailing logs are not lost."""
+    got_logs = []
+    server.register_callback(
+        "METRIC", lambda m: (got_logs.extend(m.get("logs") or []), {"type": "OK"})[1]
+    )
+    c = client_for(server, 0)
+    rep = Reporter()
+    c.start_heartbeat(rep)
+    rep.log("tail-line", verbose=False)
+    c.stop()
+    assert "tail-line" in got_logs
+
+
+def test_reservation_timeout():
+    s = rpc.Server(num_executors=3)
+    s.start(host="127.0.0.1")
+    try:
+        with pytest.raises(ReservationTimeoutError):
+            s.await_reservations(timeout=0.2)
+    finally:
+        s.stop()
+
+
+def test_large_frame_roundtrip(server):
+    server.register_callback("ECHO", lambda m: {"type": "ECHO", "blob": m["blob"]})
+    c = client_for(server)
+    blob = "x" * 1_000_000
+    assert c._request({"type": "ECHO", "blob": blob})["blob"] == blob
+    c.stop()
