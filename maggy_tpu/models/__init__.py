@@ -1,0 +1,25 @@
+from maggy_tpu.models.mlp import MLP
+from maggy_tpu.models.transformer import Decoder, DecoderConfig
+
+__all__ = ["MLP", "Decoder", "DecoderConfig"]
+
+
+def __getattr__(name):
+    import importlib
+
+    lazy = {
+        "ResNet": "maggy_tpu.models.cnn",
+        "ResNetConfig": "maggy_tpu.models.cnn",
+        "MoEDecoder": "maggy_tpu.models.moe",
+        "MoEConfig": "maggy_tpu.models.moe",
+        "Bert": "maggy_tpu.models.bert",
+        "BertConfig": "maggy_tpu.models.bert",
+    }
+    if name in lazy:
+        try:
+            return getattr(importlib.import_module(lazy[name]), name)
+        except ImportError as e:
+            raise AttributeError(
+                f"'{name}' is not available: {e}"
+            ) from e
+    raise AttributeError(f"module 'maggy_tpu.models' has no attribute {name!r}")

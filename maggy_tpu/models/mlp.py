@@ -1,0 +1,39 @@
+"""MLP classifier (BASELINE config 1: MNIST MLP single-run lagom parity)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, width in enumerate(self.features):
+            x = nn.Dense(
+                width,
+                dtype=self.dtype,
+                kernel_init=nn.with_partitioning(
+                    nn.initializers.he_normal(), ("embed", "mlp")
+                ),
+                name=f"dense_{i}",
+            )(x)
+            x = nn.relu(x)
+            if self.dropout:
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.he_normal(), ("mlp", None)
+            ),
+            name="head",
+        )(x)

@@ -1,0 +1,277 @@
+"""Flagship model family: LLaMA-style decoder-only transformer.
+
+TPU-first design (none of this exists in the reference, which orchestrates
+user-supplied torch/keras models — §2.10; this model family is what the
+BASELINE Llama-3-8B config trains):
+
+* bf16 compute / fp32 params via ``dtype``/``param_dtype`` — MXU-native.
+* RMSNorm + RoPE + SwiGLU + grouped-query attention (GQA).
+* ``scan_layers=True`` folds the layer stack into one ``nn.scan`` — O(1)
+  compile time in depth, the standard XLA-friendly layout.
+* ``remat=True`` wraps each layer in ``jax.checkpoint`` to trade FLOPs for HBM.
+* Every parameter carries logical axis names (via ``nn.with_partitioning``)
+  consumed by :mod:`maggy_tpu.parallel.sharding` — the same module runs
+  replicated, FSDP, tensor-parallel, or any mesh combination unchanged.
+* ``attention_fn`` hook: defaults to an einsum soft-max attention; the Pallas
+  flash/ring kernels in :mod:`maggy_tpu.ops` slot in here for long sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1376
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    logits_softcap: float = 0.0
+    tie_embeddings: bool = False
+    attention_fn: Optional[Callable] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads must be divisible by n_kv_heads")
+
+    @classmethod
+    def llama3_8b(cls, **overrides) -> "DecoderConfig":
+        """Llama-3-8B geometry (BASELINE config 3)."""
+        return cls(
+            **{
+                **dict(
+                    vocab_size=128_256,
+                    d_model=4096,
+                    n_layers=32,
+                    n_heads=32,
+                    n_kv_heads=8,
+                    d_ff=14_336,
+                    rope_theta=500_000.0,
+                    max_seq_len=8192,
+                    remat=True,
+                ),
+                **overrides,
+            }
+        )
+
+    @classmethod
+    def tiny(cls, **overrides) -> "DecoderConfig":
+        """Test/debug geometry: fits any host, compiles in seconds."""
+        return cls(
+            **{
+                **dict(
+                    vocab_size=256,
+                    d_model=64,
+                    n_layers=2,
+                    n_heads=4,
+                    n_kv_heads=2,
+                    d_ff=128,
+                    max_seq_len=128,
+                ),
+                **overrides,
+            }
+        )
+
+
+def _dense(features, logical_axes, cfg: DecoderConfig, name: str):
+    return nn.DenseGeneral(
+        features=features,
+        use_bias=False,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.normal(stddev=0.02), logical_axes
+        ),
+        name=name,
+    )
+
+
+class RMSNorm(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(nn.initializers.ones_init(), ("norm",)),
+            (x.shape[-1],),
+            self.cfg.param_dtype,
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.cfg.norm_eps)
+        return (y * scale).astype(self.cfg.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over the last dim of [B, S, H, D] arrays.
+
+    fp32 internally: sin/cos of large position*inv_freq products lose too much
+    precision in bf16.
+    """
+    half = x.shape[-1] // 2
+    freq = jnp.arange(half, dtype=jnp.float32) / half
+    inv_freq = theta ** (-freq)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, S, half]
+    angles = angles[:, :, None, :]  # broadcast over heads
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def default_attention(q, k, v, *, causal: bool = True, segment_ids=None):
+    """Reference soft-max attention: q [B,S,H,D], k/v [B,S,Kh,D] with GQA
+    head-group broadcast. fp32 logits/softmax for stability."""
+    b, sq, h, d = q.shape
+    kh = k.shape[2]
+    group = h // kh
+    q = q.reshape(b, sq, kh, group, d)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, None, :, None] == segment_ids[:, None, None, None, :]
+        logits = jnp.where(seg_mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
+    return out.reshape(b, sq, h, d)
+
+
+class Attention(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        hd = cfg.head_dim
+        q = _dense((cfg.n_heads, hd), ("embed", "heads", None), cfg, "wq")(x)
+        k = _dense((cfg.n_kv_heads, hd), ("embed", "kv", None), cfg, "wk")(x)
+        v = _dense((cfg.n_kv_heads, hd), ("embed", "kv", None), cfg, "wv")(x)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        attn = cfg.attention_fn or default_attention
+        out = attn(q, k, v, causal=True)
+        out = nn.DenseGeneral(
+            features=cfg.d_model,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(stddev=0.02), ("heads", None, "embed")
+            ),
+            name="wo",
+        )(out)
+        return out
+
+
+class MLPBlock(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = _dense(cfg.d_ff, ("embed", "mlp"), cfg, "w_gate")(x)
+        up = _dense(cfg.d_ff, ("embed", "mlp"), cfg, "w_up")(x)
+        return _dense(cfg.d_model, ("mlp", "embed"), cfg, "w_down")(
+            nn.silu(gate) * up
+        )
+
+
+class DecoderLayer(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, name="attn")(
+            RMSNorm(self.cfg, name="attn_norm")(x), positions
+        )
+        x = x + MLPBlock(self.cfg, name="mlp")(RMSNorm(self.cfg, name="mlp_norm")(x))
+        return x
+
+
+class _ScannedLayer(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return DecoderLayer(self.cfg, name="layer")(x, positions), None
+
+
+class Decoder(nn.Module):
+    """LLaMA-style causal LM. ``__call__(tokens [B,S]) -> logits [B,S,V]``."""
+
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+            )
+        embed = self.param(
+            "embedding",
+            nn.with_partitioning(
+                nn.initializers.normal(stddev=1.0), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = jnp.asarray(embed, cfg.dtype)[tokens]
+
+        layer_cls = _ScannedLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                layer_cls,
+                prevent_cse=not cfg.scan_layers,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,  # positions are the same for every layer
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: None},
+            )(cfg, name="layers")(x, positions)
+        else:
+            for i in range(cfg.n_layers):
+                x, _ = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+
+        x = RMSNorm(cfg, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", x, jnp.asarray(embed, cfg.dtype))
+        else:
+            logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, "lm_head")(x)
+        if cfg.logits_softcap:
+            logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+        return logits.astype(jnp.float32)

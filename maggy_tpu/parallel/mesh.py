@@ -1,0 +1,56 @@
+"""Device-mesh construction.
+
+The TPU-native replacement for the reference's NCCL/Spark topology plumbing
+(§2.9): a ``jax.sharding.Mesh`` over the five canonical axes of
+:class:`~maggy_tpu.parallel.spec.ShardingSpec`. XLA emits the collectives; the
+axis ordering below decides which collectives ride ICI vs DCN.
+
+Axis order (outer→inner): data, fsdp, expert, seq, tensor. ``jax.devices()``
+orders TPU devices so that physically adjacent chips are adjacent in the list;
+putting ``tensor`` (all-reduce every layer) innermost keeps its collectives on
+the shortest ICI paths, while ``data`` (one gradient all-reduce per step)
+outermost tolerates DCN hops across slices — the scaling-book layout recipe.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from maggy_tpu.parallel.spec import MESH_AXES, ShardingSpec
+
+
+def make_mesh(spec: ShardingSpec, devices: Optional[List] = None):
+    """Build a Mesh for ``spec``; validates the device count matches."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if spec.num_devices != len(devices):
+        raise ValueError(
+            f"ShardingSpec covers {spec.num_devices} devices but {len(devices)} "
+            f"are provided; use spec.scaled_to({len(devices)})."
+        )
+    arr = np.asarray(devices).reshape(spec.axis_sizes())
+    return Mesh(arr, MESH_AXES)
+
+
+def mesh_for(num_devices: Optional[int] = None, sharding="fsdp", devices=None):
+    """Convenience: resolve a preset/spec against the available devices."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    if isinstance(sharding, ShardingSpec):
+        spec = (
+            sharding
+            if sharding.num_devices == len(devices)
+            else sharding.scaled_to(len(devices))
+        )
+    else:
+        spec = ShardingSpec.preset(sharding, len(devices))
+    return make_mesh(spec, devices), spec

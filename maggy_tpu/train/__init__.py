@@ -1,0 +1,3 @@
+from maggy_tpu.train.trainer import Trainer, TrainContext, lm_loss_fn, classification_loss_fn
+
+__all__ = ["Trainer", "TrainContext", "lm_loss_fn", "classification_loss_fn"]

@@ -7,14 +7,18 @@ set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import tempfile
+# The TPU plugin on this image re-asserts its platform over the env var, so pin
+# the platform through jax.config too (must happen before any backend init).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
