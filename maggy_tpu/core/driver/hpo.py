@@ -83,9 +83,10 @@ class HyperparameterOptDriver(Driver):
                     raise NotImplementedError(
                         f"The hyperband pruner requires the pruner module: {e}"
                     ) from e
+                pruner_config = dict(config.pruner_config)
+                pruner_config.setdefault("direction", config.direction)
                 return Hyperband(
-                    trial_metric_getter=self._trial_metric_getter,
-                    **config.pruner_config,
+                    trial_metric_getter=self._trial_metric_getter, **pruner_config
                 )
             raise ValueError(f"Unknown pruner {config.pruner!r}")
         return config.pruner

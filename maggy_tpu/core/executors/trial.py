@@ -27,7 +27,9 @@ from maggy_tpu.exceptions import EarlyStopException
 from maggy_tpu.reporter import Reporter
 
 # keys stripped from trial params before they reach the train_fn as hparams
-_CONTROL_KEYS = ("run",)
+# ("budget" stays available via the dedicated kwarg and in hparams for ASHA-style
+# train_fns; "run"/"rep" are pure bookkeeping nonces)
+_CONTROL_KEYS = ("run", "rep")
 
 
 def trial_executor_fn(

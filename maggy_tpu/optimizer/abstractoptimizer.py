@@ -99,35 +99,37 @@ class AbstractOptimizer(ABC):
 
     # ------------------------------------------------------------- accessors
 
-    @staticmethod
-    def _strip_budget(params: Dict[str, Any]) -> Dict[str, Any]:
-        return {k: v for k, v in params.items() if k != "budget"}
+    # keys injected by the framework that are not hyperparameters
+    CONTROL_KEYS = ("budget", "run", "rep")
+
+    @classmethod
+    def _strip_budget(cls, params: Dict[str, Any]) -> Dict[str, Any]:
+        return {k: v for k, v in params.items() if k not in cls.CONTROL_KEYS}
+
+    def _observed(self, budget: Optional[float] = None) -> List[Trial]:
+        """Finalized trials with a usable metric, optionally at one budget rung.
+        One filter for both accessors below, so X and y always align."""
+        return [
+            t
+            for t in self.final_store
+            if t.final_metric is not None
+            and (budget is None or t.params.get("budget") == budget)
+        ]
 
     def get_hparams_array(self, budget: Optional[float] = None) -> np.ndarray:
-        """Design matrix of finalized trials in the unit cube, optionally filtered
+        """Design matrix of observed trials in the unit cube, optionally filtered
         to one budget rung (reference abstractoptimizer.py:186-252)."""
-        dicts = [
-            self._strip_budget(t.params)
-            for t in self.final_store
-            if budget is None or t.params.get("budget") == budget
-        ]
-        return self.searchspace.transform_many(dicts)
+        return self.searchspace.transform_many(
+            [self._strip_budget(t.params) for t in self._observed(budget)]
+        )
 
-    def get_metrics_array(
-        self, budget: Optional[float] = None, interim: bool = False
-    ) -> np.ndarray:
-        """Final metrics of finalized trials, negated under direction=max so the
+    def get_metrics_array(self, budget: Optional[float] = None) -> np.ndarray:
+        """Metrics of observed trials, negated under direction=max so the
         surrogate always minimizes (reference abstractoptimizer.py:186-252)."""
-        vals = []
-        for t in self.final_store:
-            if budget is not None and t.params.get("budget") != budget:
-                continue
-            m = t.final_metric
-            if m is None and interim and t.metric_history:
-                m = t.metric_history[-1]
-            if m is None:
-                continue
-            vals.append(-m if self.direction == "max" else m)
+        vals = [
+            -t.final_metric if self.direction == "max" else t.final_metric
+            for t in self._observed(budget)
+        ]
         return np.asarray(vals, dtype=np.float64)
 
     def hparams_exist(self, params: Dict[str, Any]) -> bool:
@@ -169,3 +171,40 @@ class AbstractOptimizer(ABC):
 
     def name(self) -> str:
         return type(self).__name__
+
+    # ------------------------------------------------------------- pruner protocol
+
+    def _find_trial(self, trial_id: str) -> Trial:
+        if trial_id in self.trial_store:
+            return self.trial_store[trial_id]
+        for t in self.final_store:
+            if t.trial_id == trial_id:
+                return t
+        raise KeyError(f"Unknown trial id {trial_id}")
+
+    def pruner_trial(self, decision: Dict[str, Any], fresh_sampler) -> Trial:
+        """Turn a pruner decision into a Trial (shared by every pruner-capable
+        optimizer). ``fresh_sampler() -> (params | None, sample_type)`` supplies
+        fresh configs; on exhaustion the slot is filled by re-running a random
+        config salted with a 'rep' nonce so trial ids never collide."""
+        trial_id, budget = decision["trial_id"], decision["budget"]
+        if trial_id is None:
+            params, sample_type = fresh_sampler()
+            if params is None:
+                self._rep_counter = getattr(self, "_rep_counter", 0) + 1
+                params = self.searchspace.sample(self._py_rng)
+                params["rep"] = self._rep_counter
+                sample_type = "repeat"
+            new = self.create_trial(
+                params, budget=budget, sample_type=sample_type, run_budget=budget
+            )
+        else:
+            base = self._find_trial(trial_id)
+            new = self.create_trial(
+                self._strip_budget(base.params),
+                budget=budget,
+                sample_type="promoted",
+                run_budget=budget,
+            )
+        self.pruner.report_trial(original_trial_id=trial_id, new_trial_id=new.trial_id)
+        return new

@@ -1,0 +1,5 @@
+from maggy_tpu.optimizer.bayes.base import BaseAsyncBO
+from maggy_tpu.optimizer.bayes.gp import GP
+from maggy_tpu.optimizer.bayes.tpe import TPE
+
+__all__ = ["BaseAsyncBO", "GP", "TPE"]

@@ -1,0 +1,179 @@
+"""Asynchronous Bayesian-optimization base.
+
+Capability parity with the reference ``maggy/optimizer/bayes/base.py:26-681``:
+a random warmup buffer, an exploration ``random_fraction``, per-budget surrogate
+models, busy-trial imputation (constant liar) so parallel workers do not pile
+onto the same optimum, and duplicate-config rejection with a bounded random
+fallback. Surrogates live in numpy (GP) — no skopt/statsmodels (§2.9).
+
+Async contract: ``get_suggestion`` is called by the driver's digestion thread
+whenever a worker needs a config; observations are whatever sits in
+``final_store`` at that moment — there is no synchronous batch.
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from maggy_tpu.optimizer.abstractoptimizer import IDLE, AbstractOptimizer
+from maggy_tpu.trial import Trial
+
+
+class BaseAsyncBO(AbstractOptimizer):
+    def __init__(
+        self,
+        num_warmup_trials: int = 15,
+        random_fraction: float = 0.33,
+        imputation: str = "cl_min",
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        if not 0 <= random_fraction <= 1:
+            raise ValueError("random_fraction must be in [0, 1]")
+        if imputation not in ("cl_min", "cl_max", "cl_mean"):
+            raise ValueError("imputation must be one of cl_min/cl_max/cl_mean")
+        self.num_warmup_trials = int(num_warmup_trials)
+        self.random_fraction = float(random_fraction)
+        self.imputation = imputation
+
+    def initialize(self) -> None:
+        warmup = min(self.num_warmup_trials, self.num_trials)
+        self._warmup_buffer = [
+            self.searchspace.sample(self._py_rng) for _ in range(warmup)
+        ]
+        self.models: Dict[Optional[float], object] = {}
+
+    # ------------------------------------------------------------------ interface
+
+    @abstractmethod
+    def fit_model(self, X: np.ndarray, y: np.ndarray):
+        """Fit and return a surrogate for (X, y) in the unit cube (y minimized)."""
+
+    @abstractmethod
+    def sample_from_model(self, model) -> np.ndarray:
+        """Propose the next point in the unit cube from a fitted surrogate."""
+
+    def get_suggestion(self, trial: Optional[Trial] = None) -> Union[Trial, str, None]:
+        if self.pruner is not None:
+            decision = self.pruner.pruning_routine()
+            if decision == "IDLE":
+                return IDLE
+            if decision is None:
+                return None
+            return self._pruner_trial(decision)
+
+        if self.num_created >= self.num_trials:
+            return IDLE if self.trial_store else None
+
+        # 1. warmup: pre-sampled random configs
+        while self._warmup_buffer:
+            params = self._warmup_buffer.pop(0)
+            if not self.hparams_exist(params):
+                return self.create_trial(params, sample_type="warmup")
+
+        # 2. exploration fraction stays random forever (async BO robustness)
+        if self.rng.random() < self.random_fraction:
+            params = self._unique_random()
+            if params is not None:
+                return self.create_trial(params, sample_type="random")
+            return IDLE if self.trial_store else None
+
+        # 3. model-based proposal
+        params = self._model_proposal()
+        if params is not None:
+            return self.create_trial(params, sample_type="model")
+        params = self._unique_random()
+        if params is not None:
+            return self.create_trial(params, sample_type="random")
+        return IDLE if self.trial_store else None
+
+    # ------------------------------------------------------------------ internals
+
+    def _pruner_trial(self, decision) -> Trial:
+        def fresh():
+            params = self._model_proposal(budget=decision["budget"])
+            if params is not None:
+                return params, "model"
+            return self._unique_random(), "random"
+
+        return self.pruner_trial(decision, fresh)
+
+    def _unique_random(self, attempts: int = 20) -> Optional[dict]:
+        for _ in range(attempts):
+            params = self.searchspace.sample(self._py_rng)
+            if not self.hparams_exist(params):
+                return params
+        return None
+
+    def _training_set(self, budget: Optional[float] = None):
+        """(X, y) at one budget rung (None = budget-less experiment) with
+        busy-location imputation: in-flight configs get a constant-liar value so
+        the acquisition avoids re-proposing them (reference bayes/base.py:400-457).
+        X and y come from the same `_observed` filter, so they always align."""
+        X_parts, y_parts = [], []
+        X_done = self.get_hparams_array(budget)
+        y_done = self.get_metrics_array(budget)
+        if X_done.size:
+            X_parts.append(X_done)
+            y_parts.append(y_done)
+        if y_done.size and self.trial_store:
+            liar = {
+                "cl_min": float(y_done.min()),
+                "cl_max": float(y_done.max()),
+                "cl_mean": float(y_done.mean()),
+            }[self.imputation]
+            busy = self.searchspace.transform_many(
+                [
+                    self._strip_budget(t.params)
+                    for t in self.trial_store.values()
+                    if budget is None or t.params.get("budget") == budget
+                ]
+            )
+            if busy.size:
+                X_parts.append(busy)
+                y_parts.append(np.full(busy.shape[0], liar))
+        if not X_parts:
+            return None, None
+        return np.concatenate(X_parts), np.concatenate(y_parts)
+
+    def _model_budget(self, target_budget: Optional[float]) -> Optional[float]:
+        """Train the surrogate at the largest budget rung with enough
+        observations (per-budget models, reference bayes/base.py:136-139);
+        fall back to the target rung itself."""
+        if target_budget is None:
+            return None
+        need = max(3, len(self.searchspace) + 1)
+        budgets = sorted(
+            {
+                t.params["budget"]
+                for t in self.final_store
+                if "budget" in t.params and t.final_metric is not None
+            },
+            reverse=True,
+        )
+        for b in budgets:
+            if len(self._observed(b)) >= need:
+                return b
+        return target_budget
+
+    def _model_proposal(
+        self, dedup_attempts: int = 3, budget: Optional[float] = None
+    ) -> Optional[dict]:
+        model_budget = self._model_budget(budget)
+        X, y = self._training_set(model_budget)
+        if X is None or len(X) < max(3, len(self.searchspace) + 1):
+            return None
+        try:
+            model = self.fit_model(X, y)
+        except Exception:  # singular kernels etc. — fall back to random
+            return None
+        self.models[model_budget] = model
+        for _ in range(dedup_attempts):
+            vec = np.clip(self.sample_from_model(model), 0.0, 1.0)
+            params = self.searchspace.inverse_transform(vec)
+            if not self.hparams_exist(params):
+                return params
+        return None

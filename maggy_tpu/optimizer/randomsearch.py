@@ -50,29 +50,12 @@ class RandomSearch(AbstractOptimizer):
             return IDLE
         if decision is None:
             return None
-        trial_id, budget = decision["trial_id"], decision["budget"]
-        if trial_id is None:
-            # fresh configuration at the pruner's starting budget
-            params = self.searchspace.sample(self._py_rng)
-            attempts = 0
-            while self.hparams_exist(params) and attempts < 50:
-                params = self.searchspace.sample(self._py_rng)
-                attempts += 1
-            new = self.create_trial(params, budget=budget, sample_type="random",
-                                    run_budget=budget)
-        else:
-            # promotion: rerun a prior config at a larger budget
-            base = self._find_trial(trial_id)
-            params = self._strip_budget(base.params)
-            new = self.create_trial(params, budget=budget, sample_type="promoted",
-                                    run_budget=budget)
-        self.pruner.report_trial(original_trial_id=trial_id, new_trial_id=new.trial_id)
-        return new
 
-    def _find_trial(self, trial_id: str) -> Trial:
-        if trial_id in self.trial_store:
-            return self.trial_store[trial_id]
-        for t in self.final_store:
-            if t.trial_id == trial_id:
-                return t
-        raise KeyError(f"Unknown trial id {trial_id}")
+        def fresh():
+            for _ in range(50):
+                params = self.searchspace.sample(self._py_rng)
+                if not self.hparams_exist(params):
+                    return params, "random"
+            return None, "random"
+
+        return self.pruner_trial(decision, fresh)

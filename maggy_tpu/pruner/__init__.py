@@ -1,0 +1,4 @@
+from maggy_tpu.pruner.abstractpruner import AbstractPruner
+from maggy_tpu.pruner.hyperband import Hyperband
+
+__all__ = ["AbstractPruner", "Hyperband"]

@@ -1,0 +1,118 @@
+"""GP / TPE surrogate tests with simulated oracles (deterministic seeds)."""
+
+import numpy as np
+import pytest
+
+from maggy_tpu import Searchspace
+from maggy_tpu.optimizer import IDLE, get_optimizer
+from maggy_tpu.optimizer.bayes.gp import GP, _FittedGP, _matern52
+from maggy_tpu.optimizer.bayes.tpe import TPE
+
+
+def space():
+    return Searchspace(x=("DOUBLE", [0.0, 1.0]), y=("DOUBLE", [0.0, 1.0]))
+
+
+def drive(opt, oracle, direction="max", num=30):
+    opt.setup(space(), num, {}, [], direction=direction)
+    finished = []
+    while True:
+        s = opt.get_suggestion()
+        if s is None:
+            break
+        if s == IDLE:
+            assert opt.trial_store
+            break
+        opt.trial_store[s.trial_id] = s
+        s.begin()
+        s.finalize(oracle(s.params))
+        del opt.trial_store[s.trial_id]
+        opt.final_store.append(s)
+        finished.append(s)
+    return finished
+
+
+def test_matern_kernel_properties():
+    X = np.random.default_rng(0).random((10, 3))
+    K = _matern52(X, X, np.ones(3))
+    np.testing.assert_allclose(np.diag(K), 1.0, atol=1e-9)
+    np.testing.assert_allclose(K, K.T, atol=1e-12)
+    assert (np.linalg.eigvalsh(K + 1e-8 * np.eye(10)) > 0).all()
+
+
+def test_gp_predict_interpolates():
+    rng = np.random.default_rng(1)
+    X = rng.random((20, 2))
+    y = np.sin(3 * X[:, 0]) + X[:, 1] ** 2
+    gp = _FittedGP(X, y, amp2=1.0, lengthscales=np.array([0.3, 0.3]), noise2=1e-6)
+    mu, sigma = gp.predict(X)
+    np.testing.assert_allclose(mu, y, atol=1e-2)
+    # uncertainty grows away from data
+    far = np.array([[5.0, 5.0]])
+    _, s_far = gp.predict(far)
+    assert s_far[0] > sigma.mean()
+
+
+@pytest.mark.parametrize("name", ["gp", "tpe"])
+def test_bo_beats_random_on_smooth_objective(name):
+    """On a smooth unimodal objective the surrogate should find a better
+    optimum than pure random search with the same trial budget."""
+
+    def oracle(p):  # max at (0.7, 0.3)
+        return -((p["x"] - 0.7) ** 2) - (p["y"] - 0.3) ** 2
+
+    budget = 40
+    bo = get_optimizer(name, seed=0, num_warmup_trials=10)
+    bo_best = max(t.final_metric for t in drive(bo, oracle, num=budget))
+
+    rnd = get_optimizer("randomsearch", seed=0)
+    rnd_best = max(t.final_metric for t in drive(rnd, oracle, num=budget))
+    assert bo_best >= rnd_best - 1e-3, (bo_best, rnd_best)
+    assert bo_best > -0.01  # close to the optimum
+
+
+def test_gp_direction_min():
+    def oracle(p):
+        return (p["x"] - 0.2) ** 2 + (p["y"] - 0.8) ** 2
+
+    gp = GP(seed=3, num_warmup_trials=8)
+    finished = drive(gp, oracle, direction="min", num=30)
+    best = min(t.final_metric for t in finished)
+    assert best < 0.02
+
+
+def test_model_proposals_are_used():
+    gp = GP(seed=5, num_warmup_trials=5, random_fraction=0.0)
+    finished = drive(gp, lambda p: p["x"], num=25)
+    kinds = {t.info_dict["sample_type"] for t in finished}
+    assert "model" in kinds
+    assert len(finished) == 25
+    assert len({t.trial_id for t in finished}) == 25  # all unique
+
+
+def test_busy_imputation_training_set():
+    gp = GP(seed=0, imputation="cl_mean")
+    gp.setup(space(), 10, {}, [], direction="max")
+    # 4 finalized + 2 busy
+    for i in range(4):
+        t = gp.create_trial({"x": 0.1 * i, "y": 0.5})
+        t.finalize(float(i))
+        gp.final_store.append(t)
+    for i in range(2):
+        t = gp.create_trial({"x": 0.9, "y": 0.05 * i})
+        gp.trial_store[t.trial_id] = t
+    X, y = gp._training_set()
+    assert X.shape == (6, 2)
+    # imputed values equal the mean of observed (negated) metrics
+    np.testing.assert_allclose(y[-2:], y[:4].mean())
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        GP(acq_fun="ucb")
+    with pytest.raises(ValueError):
+        TPE(gamma=1.5)
+    with pytest.raises(ValueError):
+        GP(random_fraction=2.0)
+    with pytest.raises(ValueError):
+        GP(imputation="median")

@@ -1,0 +1,93 @@
+"""Hyperband pruner tests: bracket geometry, promotion ranking, straggler
+IDLE behavior, and a full lagom e2e run with the pruner attached."""
+
+import pytest
+
+from maggy_tpu import Searchspace, experiment
+from maggy_tpu.config import HyperparameterOptConfig
+from maggy_tpu.pruner.hyperband import Hyperband
+
+
+def test_bracket_geometry():
+    metrics = {}
+    hb = Hyperband(lambda ids: {i: metrics.get(i) for i in ids if i in metrics},
+                   eta=3, resource_min=1, resource_max=9)
+    # s_max = 2 -> brackets s=2,1,0
+    caps = [[r.capacity for r in b.rungs] for b in hb.brackets]
+    budgets = [[r.budget for r in b.rungs] for b in hb.brackets]
+    assert caps == [[9, 3, 1], [5, 1], [3]]
+    assert budgets == [[1, 3, 9], [3, 9], [9]]
+    assert hb.num_trials() == 9 + 3 + 1 + 5 + 1 + 3
+
+
+def test_promotion_respects_direction_and_errors():
+    finished = {}
+    hb = Hyperband(lambda ids: {i: finished[i] for i in ids if i in finished},
+                   eta=2, resource_min=1, resource_max=2, direction="max")
+    # single bracket rungs: [2,1] at budgets [1,2] + bracket s=0: [2] at [2]
+    d = hb.pruning_routine()
+    assert d == {"trial_id": None, "budget": 1}
+    hb.report_trial(None, "t0")
+    d = hb.pruning_routine()
+    assert d == {"trial_id": None, "budget": 1}
+    hb.report_trial(None, "t1")
+    # rung 0 full but unfinished -> the s=0 bracket's base rung fills next
+    d = hb.pruning_routine()
+    assert d["trial_id"] is None and d["budget"] == 2
+    hb.report_trial(None, "t2")
+    d = hb.pruning_routine()
+    assert d["trial_id"] is None and d["budget"] == 2
+    hb.report_trial(None, "t3")
+    # everything scheduled except promotion slot; stragglers -> IDLE
+    assert hb.pruning_routine() == "IDLE"
+    finished["t0"] = 0.1
+    finished["t1"] = None  # errored trial counts as finished, ranked worst
+    d = hb.pruning_routine()
+    assert d == {"trial_id": "t0", "budget": 2}
+    hb.report_trial("t0", "t0b")
+    # every slot scheduled -> schedule exhausted (None) even while trials run;
+    # the driver itself waits for in-flight trials to finalize
+    assert hb.pruning_routine() is None
+
+
+def test_pending_must_be_reported():
+    hb = Hyperband(lambda ids: {}, eta=2, resource_min=1, resource_max=2)
+    d = hb.pruning_routine()
+    assert d["trial_id"] is None
+    assert hb.pruning_routine() == "IDLE"  # decision not yet reported
+    hb.report_trial(None, "x")
+    assert hb.pruning_routine()["trial_id"] is None
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        Hyperband(lambda ids: {}, eta=1)
+    with pytest.raises(ValueError):
+        Hyperband(lambda ids: {}, resource_min=5, resource_max=2)
+
+
+def test_lagom_hyperband_e2e(tmp_env):
+    budgets_seen = []
+
+    def train(hparams, budget, reporter):
+        budgets_seen.append(budget)
+        for step in range(int(budget)):
+            reporter.broadcast(hparams["x"], step=step)
+        return hparams["x"]
+
+    cfg = HyperparameterOptConfig(
+        num_trials=1,  # overridden by the pruner schedule
+        optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0.0, 1.0])),
+        direction="max",
+        num_executors=4,
+        es_policy="none",
+        hb_interval=0.05,
+        pruner="hyperband",
+        pruner_config={"eta": 3, "resource_min": 1, "resource_max": 9},
+        seed=7,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] == 9 + 3 + 1 + 5 + 1 + 3
+    assert set(budgets_seen) == {1, 3, 9}
+    assert result["errors"] == 0
