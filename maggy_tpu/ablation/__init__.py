@@ -1,0 +1,3 @@
+from maggy_tpu.ablation.ablationstudy import AblationStudy, Features, ModelSpec
+
+__all__ = ["AblationStudy", "Features", "ModelSpec"]

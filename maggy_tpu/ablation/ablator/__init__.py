@@ -1,0 +1,4 @@
+from maggy_tpu.ablation.ablator.abstractablator import AbstractAblator
+from maggy_tpu.ablation.ablator.loco import LOCO
+
+__all__ = ["AbstractAblator", "LOCO"]

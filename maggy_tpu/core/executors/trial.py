@@ -41,6 +41,7 @@ def trial_executor_fn(
     server_addr,
     secret: str,
     devices: Optional[list] = None,
+    resolve: Optional[Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]]] = None,
 ) -> Callable[[], None]:
     def _executor() -> None:
         env = EnvSing.get_instance()
@@ -89,6 +90,9 @@ def trial_executor_fn(
             "trial_dir": trial_dir,
             "budget": params.get("budget"),
         }
+        if resolve is not None:
+            # experiment-kind hook: ablation swaps in per-trial model/dataset
+            available = resolve(params, available)
         kwargs = util.inject_kwargs(train_fn, available)
 
         metric: Optional[float] = None

@@ -1,0 +1,139 @@
+"""BERT-style bidirectional encoder (BASELINE config 4: BERT-base ablation).
+
+Shares the TPU-first conventions of the decoder family (bf16 compute, logical
+partitioning, scan/remat) with learned positions, bidirectional blockwise
+attention, and a pooled classification head. Components are named so an
+AblationStudy factory can drop them (``study.model.set_factory``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, FrozenSet
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from maggy_tpu.models.transformer import _dense
+from maggy_tpu.ops.attention import blockwise_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    num_classes: int = 2
+    dropout: float = 0.1
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    ablated: FrozenSet[str] = frozenset()  # component names dropped by LOCO
+
+    @classmethod
+    def base(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        return cls(
+            **{
+                **dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                       max_seq_len=64, dropout=0.0),
+                **kw,
+            }
+        )
+
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool = False):
+        cfg = self.cfg
+        hd = cfg.head_dim()
+        norm = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        h = norm("attn_norm")(x)
+        q = _dense((cfg.n_heads, hd), ("embed", "heads", None), cfg, "wq")(h)
+        k = _dense((cfg.n_heads, hd), ("embed", "kv", None), cfg, "wk")(h)
+        v = _dense((cfg.n_heads, hd), ("embed", "kv", None), cfg, "wv")(h)
+        attn = blockwise_attention(q, k, v, causal=False, segment_ids=mask)
+        attn = nn.DenseGeneral(
+            cfg.d_model,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), ("heads", None, "embed")
+            ),
+            name="wo",
+        )(attn)
+        x = x + attn
+        h = norm("mlp_norm")(x)
+        h = _dense(cfg.d_ff, ("embed", "mlp"), cfg, "w_in")(h)
+        h = nn.gelu(h)
+        h = _dense(cfg.d_model, ("mlp", "embed"), cfg, "w_out")(h)
+        if cfg.dropout and train:
+            h = nn.Dropout(cfg.dropout, deterministic=False)(h)
+        return x + h
+
+
+class Bert(nn.Module):
+    """``__call__(tokens [B,S], attention_mask [B,S]?) -> (pooled_logits,
+    sequence_output)``. Ablatable components: "position_embeddings", "pooler",
+    and any "layer_{i}"."""
+
+    cfg: BertConfig = BertConfig.tiny()
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, train: bool = False):
+        cfg = self.cfg
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(tokens)
+        embed = self.param(
+            "embedding",
+            nn.with_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = jnp.asarray(embed, cfg.dtype)[tokens]
+        if "position_embeddings" not in cfg.ablated:
+            pos = self.param(
+                "position_embedding",
+                nn.with_partitioning(nn.initializers.normal(0.02), (None, "embed")),
+                (cfg.max_seq_len, cfg.d_model),
+                cfg.param_dtype,
+            )
+            x = x + jnp.asarray(pos[: tokens.shape[1]], cfg.dtype)[None]
+
+        # segment ids: padding tokens get -1 so they never attend/are attended
+        seg = jnp.where(attention_mask > 0, 0, -1).astype(jnp.int32)
+        for i in range(cfg.n_layers):
+            if f"layer_{i}" in cfg.ablated:
+                continue
+            x = BertLayer(cfg, name=f"layer_{i}")(x, seg, train)
+        x = nn.LayerNorm(
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="final_norm",
+        )(x)
+
+        cls = x[:, 0]
+        if "pooler" not in cfg.ablated:
+            cls = jnp.tanh(_dense(cfg.d_model, ("embed", "embed"), cfg, "pooler")(cls))
+        logits = nn.Dense(
+            cfg.num_classes,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            name="classifier",
+        )(cls)
+        return logits, x

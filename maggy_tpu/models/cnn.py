@@ -1,0 +1,115 @@
+"""ResNet for image classification (BASELINE config 2: ResNet-50/CIFAR-10 ASHA).
+
+TPU-first: NHWC layout (XLA's native conv layout on TPU), bf16 compute option,
+and logical partitioning on conv kernels so FSDP shards the output-channel
+axis. Standard v1.5 bottleneck blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _norm(cfg, channels: int, name: str):
+    return nn.GroupNorm(
+        num_groups=math.gcd(32, channels),
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        name=name,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 10
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    small_inputs: bool = True  # CIFAR stem (3x3, no maxpool) vs ImageNet stem
+
+    @classmethod
+    def resnet18(cls, **kw) -> "ResNetConfig":
+        return cls(**{**dict(stage_sizes=(2, 2, 2, 2)), **kw})
+
+    @classmethod
+    def resnet50(cls, **kw) -> "ResNetConfig":
+        return cls(**kw)
+
+
+def _conv(features, kernel, strides, cfg, name):
+    return nn.Conv(
+        features,
+        kernel,
+        strides=strides,
+        use_bias=False,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.he_normal(),
+            ("conv_spatial", "conv_spatial", "conv_in", "conv_out"),
+        ),
+        name=name,
+    )
+
+
+class BottleneckBlock(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        # GroupNorm: batch-stat-free (TPU friendly); groups adapt to narrow nets
+        residual = x
+        y = nn.relu(_norm(cfg, self.features, "n1")(
+            _conv(self.features, (1, 1), 1, cfg, "conv1")(x)))
+        y = nn.relu(_norm(cfg, self.features, "n2")(
+            _conv(self.features, (3, 3), self.strides, cfg, "conv2")(y)))
+        y = _norm(cfg, self.features * 4, "n3")(
+            _conv(self.features * 4, (1, 1), 1, cfg, "conv3")(y))
+        if residual.shape != y.shape:
+            residual = _norm(cfg, self.features * 4, "np")(
+                _conv(self.features * 4, (1, 1), self.strides, cfg, "proj")(x)
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig = ResNetConfig()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        if cfg.small_inputs:
+            x = _conv(cfg.width, (3, 3), 1, cfg, "stem")(x)
+        else:
+            x = _conv(cfg.width, (7, 7), 2, cfg, "stem")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(_norm(cfg, cfg.width, "stem_norm")(x))
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    cfg,
+                    features=cfg.width * 2**stage,
+                    strides=strides,
+                    name=f"stage{stage}_block{block}",
+                )(x, train)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(
+            cfg.num_classes,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.zeros_init(), ("embed", None)
+            ),
+            name="head",
+        )(x)

@@ -1,0 +1,11 @@
+from maggy_tpu.ops.attention import blockwise_attention, online_block_update
+
+__all__ = ["blockwise_attention", "online_block_update"]
+
+
+def __getattr__(name):
+    import importlib
+
+    if name == "flash_attention":
+        return importlib.import_module("maggy_tpu.ops.flash").flash_attention
+    raise AttributeError(f"module 'maggy_tpu.ops' has no attribute {name!r}")

@@ -1,0 +1,145 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Same online-softmax math as :mod:`maggy_tpu.ops.attention`, hand-tiled for the
+MXU: grid (batch*heads, q_blocks, k_blocks) with fp32 running statistics in
+VMEM scratch, causal blocks skipped wholesale, and the [S, S] score matrix
+never leaving VMEM tiles. Inference/scoring path — for training use
+``blockwise_attention`` (differentiable) or ring attention (distributed).
+
+Falls back to the interpreter off-TPU so tests run on CPU meshes; shapes that
+do not tile evenly fall back to ``blockwise_attention``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from maggy_tpu.ops.attention import NEG_INF, _repeat_kv, blockwise_attention
+
+_LANES = 128
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # causal: skip blocks strictly above the diagonal (always "needed" otherwise)
+    needed = (k_start <= q_start + block_q - 1) if causal else (ki >= 0)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (q_start + rows) >= (k_start + cols)
+            s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_cur)
+        l_new = l_ref[:, :1] * corr + p.sum(axis=1, keepdims=True)
+        # stats stored replicated across lanes (full-width VMEM stores)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr + pv
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+    segment_ids=None,
+) -> jax.Array:
+    """q [B,S,H,D], k/v [B,S,Kh,D] → [B,S,H,D]."""
+    if segment_ids is not None:
+        return blockwise_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    b, sq, h, d = q.shape
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k or d % _LANES:
+        return blockwise_attention(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    grid = (b * h, sq // block_q, sk // block_k)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            scale=1.0 / d**0.5,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d), lambda i, qi, ki: (i, qi, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, qi, ki: (i, ki, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda i, qi, ki: (i, ki, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda i, qi, ki: (i, qi, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -114,13 +114,33 @@ class Trainer:
     def _build_train_step(self):
         def train_step(state: TrainState, batch):
             def loss_of(params):
-                logits = state.apply_fn({"params": params}, *_model_inputs(batch))
-                return self.loss_fn(logits, batch)
+                # mutable intermediates so modules can sow auxiliary losses
+                # (MoE router balancing); "*aux_loss" leaves are added to the
+                # objective — without this, flax `sow` is a silent no-op
+                logits, mods = state.apply_fn(
+                    {"params": params}, *_model_inputs(batch), mutable=["intermediates"]
+                )
+                loss = self.loss_fn(logits, batch)
+                aux = 0.0
+                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    mods.get("intermediates", {})
+                )[0]:
+                    if "aux_loss" in jax.tree_util.keystr(path):
+                        aux = aux + jnp.sum(leaf)
+                return loss + aux, (loss, aux)
 
-            loss, grads = jax.value_and_grad(loss_of)(state.params)
+            (total, (loss, aux)), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                state.params
+            )
             new_state = state.apply_gradients(grads=grads)
             gnorm = optax.global_norm(grads)
-            return new_state, {"loss": loss, "grad_norm": gnorm, "step": state.step}
+            return new_state, {
+                "loss": loss,
+                "aux_loss": aux,
+                "total_loss": total,
+                "grad_norm": gnorm,
+                "step": state.step,
+            }
 
         return jax.jit(train_step, donate_argnums=(0,))
 
