@@ -30,4 +30,8 @@ def __getattr__(name):
         return importlib.import_module("maggy_tpu.experiment")
     if name == "AblationStudy":
         return importlib.import_module("maggy_tpu.ablation").AblationStudy
+    if name == "tensorboard":
+        return importlib.import_module("maggy_tpu.tensorboard")
+    if name == "callbacks":
+        return importlib.import_module("maggy_tpu.callbacks")
     raise AttributeError(f"module 'maggy_tpu' has no attribute {name!r}")

@@ -1,0 +1,70 @@
+"""Training-loop callbacks bridging user frameworks to the Reporter.
+
+Capability parity with the reference ``maggy/callbacks.py`` (callbacks.py:20-66
+KerasBatchEnd/KerasEpochEnd): hooks that forward a chosen metric to
+``reporter.broadcast`` so early stopping and the driver's monitoring plane work
+without the user writing broadcast calls. The JAX-native variant is a plain
+callable for step loops; Keras variants are provided when TF is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ReporterCallback:
+    """JAX-native: call ``cb(metrics_dict, step)`` at step/epoch boundaries."""
+
+    def __init__(self, reporter, metric: str = "loss", negate: bool = False,
+                 every: int = 1):
+        self.reporter = reporter
+        self.metric = metric
+        self.negate = negate
+        self.every = max(1, int(every))
+
+    def __call__(self, metrics, step: int) -> None:
+        if step % self.every:
+            return
+        value = float(metrics[self.metric])
+        self.reporter.broadcast(-value if self.negate else value, step=int(step))
+
+
+def KerasBatchEnd(reporter, metric: str = "loss"):
+    """Keras callback broadcasting at batch end (reference callbacks.py:20)."""
+    keras = _keras()
+
+    class _BatchEnd(keras.callbacks.Callback):
+        def __init__(self):
+            super().__init__()
+            self._step = 0
+
+        def on_train_batch_end(self, batch, logs=None):
+            if logs and metric in logs:
+                reporter.broadcast(float(logs[metric]), step=self._step)
+            self._step += 1
+
+    return _BatchEnd()
+
+
+def KerasEpochEnd(reporter, metric: str = "val_loss"):
+    """Keras callback broadcasting at epoch end (reference callbacks.py:45)."""
+    keras = _keras()
+
+    class _EpochEnd(keras.callbacks.Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            if logs and metric in logs:
+                reporter.broadcast(float(logs[metric]), step=int(epoch))
+
+    return _EpochEnd()
+
+
+def _keras():
+    try:
+        from tensorflow import keras  # pragma: no cover - needs TF installed
+
+        return keras
+    except ImportError as e:
+        raise ImportError(
+            "Keras callbacks require tensorflow; use ReporterCallback for "
+            "JAX training loops."
+        ) from e

@@ -38,6 +38,7 @@ class HyperparameterOptConfig(LagomConfig):
         pruner_config: Optional[dict] = None,
         seed: Optional[int] = None,
         log_dir: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
         """:param num_trials: total trials to run (pruner may override, as in the
             reference optimization_driver.py:88-93).
@@ -54,6 +55,8 @@ class HyperparameterOptConfig(LagomConfig):
         :param devices_per_trial: devices leased to each trial (sub-slice size).
         :param pruner: optional "hyperband" or AbstractPruner instance.
         :param seed: RNG seed for samplers/surrogates.
+        :param resume_from: path to a previous experiment directory; its
+            finalized trials are preloaded and never re-run.
         """
         super().__init__(name, description, hb_interval)
         if not isinstance(num_trials, int) or num_trials <= 0:
@@ -80,3 +83,4 @@ class HyperparameterOptConfig(LagomConfig):
         self.pruner_config = dict(pruner_config or {})
         self.seed = seed
         self.log_dir = log_dir
+        self.resume_from = resume_from

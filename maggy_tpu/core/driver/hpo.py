@@ -53,6 +53,15 @@ class HyperparameterOptDriver(Driver):
             num_trials = GridSearch.get_num_trials(config.searchspace)
         self.num_trials = num_trials
 
+        # resume: preload a previous run's finalized trials so the controller
+        # observes them and the driver never re-schedules them (§5.4 upgrade
+        # over the reference, which cannot resume experiments)
+        if getattr(config, "resume_from", None):
+            from maggy_tpu.train.checkpoint import load_finalized_trials
+
+            for trial in load_finalized_trials(config.resume_from):
+                self.final_store.append(trial)
+
         self.controller = get_optimizer(config.optimizer, seed=config.seed)
         self.controller.setup(
             config.searchspace,
@@ -153,6 +162,11 @@ class HyperparameterOptDriver(Driver):
         return {"type": "OK"}
 
     def _final_callback(self, msg) -> Dict[str, Any]:
+        # unassign synchronously (event loop), before the reply: the worker's
+        # next GET must never see its finished trial still assigned, or it
+        # would run it twice (reference clears in the socket thread too,
+        # rpc.py:463-471)
+        self.server.reservations.assign_trial(msg["partition_id"], None)
         self.server.enqueue(msg)
         return {"type": "OK"}
 
@@ -249,7 +263,7 @@ class HyperparameterOptDriver(Driver):
         with self.lock:
             self.final_store.append(trial)
         self._persist_trial(trial)
-        self.server.reservations.assign_trial(pid, None)
+        # reservation already cleared synchronously by _final_callback
         self.log(
             f"Trial {trial_id} {trial.status} metric={trial.final_metric} "
             f"({len(self.final_store)} done)"
@@ -269,7 +283,17 @@ class HyperparameterOptDriver(Driver):
             return
         with self.lock:
             finished = self.final_store[-1] if self.final_store else None
+            done_ids = {t.trial_id for t in self.final_store}
         suggestion = self.controller.get_suggestion(finished)
+        # resumed experiments: skip suggestions that already finalized in the
+        # previous run (bounded — each skip consumes the controller's budget)
+        skips = 0
+        while isinstance(suggestion, Trial) and suggestion.trial_id in done_ids:
+            skips += 1
+            if skips > self.num_trials + 1:
+                suggestion = None
+                break
+            suggestion = self.controller.get_suggestion(None)
         if isinstance(suggestion, Trial):
             suggestion.schedule(pid)
             with self.lock:

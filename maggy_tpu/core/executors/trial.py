@@ -69,9 +69,12 @@ def trial_executor_fn(
             reporter.close()
 
     def _run_trial(reply: Dict[str, Any], client: rpc.Client, reporter: Reporter, env) -> None:
+        from maggy_tpu import tensorboard as tb
+
         trial_id, params = reply["trial_id"], dict(reply["params"])
         reporter.reset(trial_id)
         trial_dir = env.trial_dir(app_id, run_id, trial_id)
+        tb._register(trial_dir)  # registry only; persistence is the line below
         try:
             env.dump(util._jsonify(params), os.path.join(trial_dir, constants.HPARAMS_FILE))
         except OSError:
@@ -114,6 +117,7 @@ def trial_executor_fn(
             error = f"{type(e).__name__}: {e}"
             reporter.log(f"Trial {trial_id} failed:\n{traceback.format_exc()}")
 
+        tb._unregister()
         client.finalize_metric(
             trial_id,
             metric,

@@ -1,0 +1,88 @@
+"""Per-trial TensorBoard/metrics directory registry.
+
+Capability parity with the reference ``maggy/tensorboard.py`` (tensorboard.py:
+28-107): user code calls ``tensorboard.logdir()`` inside train_fn to get the
+current trial's log directory, and the framework records hyperparameters per
+trial. Differences forced by the TPU execution model: executors are threads in
+one process (not separate Spark processes), so the registry is thread-local;
+and the event writer is optional — metrics always land in ``events.jsonl``,
+and additionally in real TF event files when ``tensorboard`` is importable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+_local = threading.local()
+
+
+def _env():
+    from maggy_tpu.core.env import EnvSing
+
+    return EnvSing.get_instance()
+
+
+def _register(logdir: str) -> None:
+    """Called by the trial executor at trial start (reference tensorboard.py:28-44)."""
+    _local.logdir = logdir
+    _local.writer = None
+
+
+def _unregister() -> None:
+    writer = getattr(_local, "writer", None)
+    if writer is not None:
+        try:
+            writer.close()
+        except Exception:
+            pass
+    _local.logdir = None
+    _local.writer = None
+
+
+def logdir() -> str:
+    """The current trial's log directory; raises outside a trial context."""
+    d = getattr(_local, "logdir", None)
+    if d is None:
+        raise RuntimeError(
+            "tensorboard.logdir() is only available inside a running trial."
+        )
+    return d
+
+
+def write_hparams(hparams: Dict[str, Any], logdir: Optional[str] = None) -> None:
+    """Persist the trial's hyperparameters (reference tensorboard.py:104-107).
+    Goes through the Env abstraction so GCS experiment dirs work too."""
+    d = logdir or globals()["logdir"]()
+    _env().dump(hparams, os.path.join(d, "hparams.json"))
+
+
+def scalar(tag: str, value: float, step: int) -> None:
+    """Log one scalar for the current trial: always to events.jsonl, and to TF
+    event files when the tensorboard package is available."""
+    d = logdir()
+    with _env().open_file(os.path.join(d, "events.jsonl"), "a") as f:
+        f.write(
+            json.dumps(
+                {"tag": tag, "value": float(value), "step": int(step), "ts": time.time()}
+            )
+            + "\n"
+        )
+    writer = getattr(_local, "writer", None)
+    if writer is None:
+        try:
+            from tensorboard.summary.writer.event_file_writer import EventFileWriter  # noqa: F401
+            from tensorboardX import SummaryWriter  # pragma: no cover
+
+            writer = SummaryWriter(d)
+        except Exception:
+            writer = False  # probed once, unavailable
+        _local.writer = writer
+    if writer:
+        try:  # pragma: no cover - only with tensorboardX installed
+            writer.add_scalar(tag, float(value), int(step))
+        except Exception:
+            pass

@@ -1,0 +1,91 @@
+"""Checkpointing and experiment resume.
+
+The reference has **no model checkpointing** (SURVEY.md §5.4 — users hand-roll
+saves inside train_fn); here it is first-class:
+
+* :class:`Checkpointer` — orbax-backed async save/restore of (sharded)
+  TrainStates into a trial directory; restore rebuilds arrays directly on
+  their mesh devices from the abstract target.
+* experiment resume — ``HyperparameterOptConfig(resume_from=<exp_dir>)``
+  preloads that experiment's persisted ``trial.json`` records into the new
+  driver's final store, so finished trials are never re-run (the driver skips
+  suggestions whose trial id already finalized).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+
+class Checkpointer:
+    """Thin orbax wrapper bound to one directory (per trial or per run)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._manager.save(int(step), args=ocp.args.StandardSave(state))
+
+    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+        """Restore onto the template's shardings (pass an abstract or concrete
+        state built by ``Trainer.make_state``)."""
+        import orbax.checkpoint as ocp
+
+        step = int(step) if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint found under {self.directory}")
+        return self._manager.restore(
+            step, args=ocp.args.StandardRestore(state_template)
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def all_steps(self) -> List[int]:
+        return list(self._manager.all_steps())
+
+    def wait(self) -> None:
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()
+
+
+def load_finalized_trials(exp_dir: str) -> list:
+    """Load every persisted trial.json under a previous experiment directory
+    (the driver's persistence format, hpo.py _persist_trial). Goes through the
+    Env abstraction so gs:// experiment dirs resume too."""
+    import json
+
+    from maggy_tpu.core.env import EnvSing
+    from maggy_tpu.trial import Trial
+
+    env = EnvSing.get_instance()
+    out = []
+    if not env.exists(exp_dir):
+        raise FileNotFoundError(f"resume_from directory does not exist: {exp_dir}")
+    for name in env.listdir(exp_dir):
+        path = os.path.join(exp_dir, name, "trial.json")
+        if not env.exists(path):
+            continue
+        try:
+            trial = Trial.from_dict(env.load_json(path))
+        except (json.JSONDecodeError, KeyError, ValueError):
+            continue
+        if trial.status in (Trial.FINALIZED, Trial.ERROR):
+            out.append(trial)
+    return out

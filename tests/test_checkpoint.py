@@ -1,0 +1,98 @@
+"""Checkpoint/resume: sharded TrainState round-trip via orbax, and HPO
+experiment resume skipping finalized trials."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from maggy_tpu import Searchspace, experiment
+from maggy_tpu.config import HyperparameterOptConfig
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.parallel.spec import ShardingSpec
+from maggy_tpu.train import TrainContext
+from maggy_tpu.train.checkpoint import Checkpointer, load_finalized_trials
+from maggy_tpu.train.data import synthetic_lm_batches
+
+
+def test_sharded_state_roundtrip(tmp_path):
+    cfg = DecoderConfig.tiny()
+    ctx = TrainContext.create(ShardingSpec(dp=2, fsdp=2, tp=2))
+    trainer = ctx.trainer(Decoder(cfg), optax.adamw(1e-3))
+    data = synthetic_lm_batches(cfg.vocab_size, 8, 32, seed=0)
+    state = trainer.make_state(jax.random.key(0), next(data))
+    for _ in range(3):
+        state, _ = trainer.step(state, trainer.shard_batch(next(data)))
+
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), async_save=False)
+    ckpt.save(int(state.step), state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 3
+
+    # fresh template (different rng -> different values), restore over it
+    template = trainer.make_state(jax.random.key(9), next(data))
+    restored = ckpt.restore(template)
+    ckpt.close()
+
+    import flax.linen as nn
+
+    def unwrap(x):
+        return x.value if isinstance(x, nn.Partitioned) else x
+
+    a = unwrap(state.params["embedding"])
+    b = unwrap(restored.params["embedding"])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert b.sharding == a.sharding  # restored onto the same mesh layout
+    assert int(restored.step) == 3
+    # training continues from the restored state
+    restored, m = trainer.step(restored, trainer.shard_batch(next(data)))
+    assert int(restored.step) == 4
+
+
+def test_checkpointer_missing(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "empty"), async_save=False)
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore({"x": np.zeros(2)})
+    ckpt.close()
+
+
+def test_experiment_resume_skips_finished(tmp_env):
+    calls = []
+
+    def train(hparams, reporter):
+        calls.append(round(hparams["x"], 6))
+        return hparams["x"]
+
+    sp = Searchspace(x=("DOUBLE", [0.0, 1.0]))
+    cfg1 = HyperparameterOptConfig(
+        num_trials=4, optimizer="randomsearch", searchspace=sp,
+        num_executors=2, es_policy="none", hb_interval=0.05, seed=42,
+    )
+    r1 = experiment.lagom(train, cfg1)
+    assert r1["num_trials"] == 4
+    first_run_dir = tmp_env.experiment_dir(experiment.APP_ID, experiment.RUN_ID)
+    assert len(load_finalized_trials(first_run_dir)) == 4
+    first_calls = list(calls)
+
+    # resume with a larger budget and the same seed: the 4 finished configs
+    # must not run again
+    calls.clear()
+    cfg2 = HyperparameterOptConfig(
+        num_trials=8, optimizer="randomsearch", searchspace=sp,
+        num_executors=2, es_policy="none", hb_interval=0.05, seed=42,
+        resume_from=first_run_dir,
+    )
+    r2 = experiment.lagom(train, cfg2)
+    assert r2["num_trials"] == 8  # 4 preloaded + 4 new
+    assert len(calls) == 4
+    assert not set(calls) & set(first_calls)
+
+
+def test_resume_from_missing_dir(tmp_env):
+    cfg = HyperparameterOptConfig(
+        num_trials=2, optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0, 1])),
+        resume_from="/nonexistent/dir", es_policy="none",
+    )
+    with pytest.raises(FileNotFoundError):
+        experiment.lagom(lambda hparams: 1.0, cfg)

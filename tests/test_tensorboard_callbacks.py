@@ -1,0 +1,60 @@
+"""tensorboard registry + callbacks: logdir inside trials, scalar logging,
+ReporterCallback semantics."""
+
+import json
+import os
+
+import pytest
+
+from maggy_tpu import Reporter, Searchspace, experiment, exceptions
+from maggy_tpu.callbacks import ReporterCallback
+from maggy_tpu.config import HyperparameterOptConfig
+
+
+def test_logdir_outside_trial_raises():
+    from maggy_tpu import tensorboard as tb
+
+    with pytest.raises(RuntimeError, match="inside a running trial"):
+        tb.logdir()
+
+
+def test_logdir_and_scalars_inside_lagom(tmp_env):
+    from maggy_tpu import tensorboard as tb
+
+    seen_dirs = []
+
+    def train(hparams, reporter):
+        d = tb.logdir()
+        seen_dirs.append(d)
+        tb.scalar("acc", hparams["x"], step=0)
+        tb.scalar("acc", hparams["x"] + 0.1, step=1)
+        return hparams["x"]
+
+    cfg = HyperparameterOptConfig(
+        num_trials=3, optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0, 1])),
+        num_executors=2, es_policy="none", hb_interval=0.05, seed=0,
+    )
+    experiment.lagom(train, cfg)
+    assert len(set(seen_dirs)) == 3  # one registry entry per trial
+    for d in seen_dirs:
+        assert os.path.exists(os.path.join(d, ".hparams.json"))
+        lines = open(os.path.join(d, "events.jsonl")).read().splitlines()
+        assert len(lines) == 2
+        assert json.loads(lines[1])["step"] == 1
+    # unregistered after the experiment
+    with pytest.raises(RuntimeError):
+        tb.logdir()
+
+
+def test_reporter_callback():
+    r = Reporter()
+    cb = ReporterCallback(r, metric="loss", negate=True, every=2)
+    cb({"loss": 0.5}, step=0)
+    cb({"loss": 0.4}, step=1)  # skipped (every=2)
+    cb({"loss": 0.3}, step=2)
+    _, metric, step, _ = r.get_data()
+    assert metric == -0.3 and step == 2
+    r.early_stop()
+    with pytest.raises(exceptions.EarlyStopException):
+        cb({"loss": 0.2}, step=4)
