@@ -36,6 +36,9 @@ class DistributedConfig(LagomConfig):
         num_executors: Optional[int] = None,
         seed: int = 0,
         log_dir: Optional[str] = None,
+        driver_addr: Optional[str] = None,
+        data_plane: str = "auto",
+        worker_timeout: float = 1800.0,
     ):
         """:param module: a flax ``nn.Module`` class, instance, or zero-arg factory —
             the analogue of the reference's torch module class argument
@@ -72,6 +75,18 @@ class DistributedConfig(LagomConfig):
         self.num_executors = num_executors
         self.seed = int(seed)
         self.log_dir = log_dir
+        # Pod mode: every host runs the same script; non-zero hosts connect to
+        # the driver here instead of starting their own (env override:
+        # MAGGY_TPU_DRIVER="host:port"). The secret rides MAGGY_TPU_SECRET.
+        self.driver_addr = driver_addr
+        if data_plane not in ("auto", "local"):
+            raise ValueError("data_plane must be 'auto' or 'local'")
+        # "auto": form one global mesh across pod hosts via jax.distributed;
+        # "local": each worker keeps a host-local mesh (independent replicas —
+        # also what control-plane tests use)
+        self.data_plane = data_plane
+        # pod mode: abort the run if a registered worker goes silent this long
+        self.worker_timeout = float(worker_timeout)
 
     def resolve_sharding(self, num_devices: int) -> ShardingSpec:
         if isinstance(self.sharding, ShardingSpec):

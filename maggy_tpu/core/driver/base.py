@@ -125,9 +125,13 @@ class Driver(ABC):
         )
         self._digestion_thread.start()
 
+    def _local_partitions(self) -> List[int]:
+        """Partitions this process hosts; pod-mode drivers narrow this."""
+        return list(range(self.num_executors))
+
     def _launch_executors(self, train_fn: Callable) -> None:
         groups = self._device_groups()
-        for pid in range(self.num_executors):
+        for pid in self._local_partitions():
             devices = groups[pid % len(groups)] if groups else []
             fn = self._executor_fn(train_fn, pid, devices)
             t = threading.Thread(

@@ -13,7 +13,9 @@ means exactly one worker spanning all visible devices.
 
 from __future__ import annotations
 
+import os
 import statistics
+import time
 from typing import Any, Callable, Dict, List
 
 from maggy_tpu.core import rpc
@@ -33,6 +35,13 @@ class DistributedTrainingDriver(Driver):
         self.num_executors = config.num_executors or default_workers
         self._finals: List[Dict[str, Any]] = []
         self._coordinator = None  # host:port of worker 0, filled at registration
+        self._last_seen: Dict[int, float] = {}  # partition -> last contact ts
+        self._final_pids: set = set()
+        # pod mode: remote hosts run their own copy of the script and connect
+        # as workers (core/pod.py); this driver launches only partition 0
+        self.pod_mode = bool(
+            os.environ.get("MAGGY_TPU_DRIVER") or getattr(config, "driver_addr", None)
+        )
 
     # ------------------------------------------------------------------ server
 
@@ -46,6 +55,15 @@ class DistributedTrainingDriver(Driver):
             "QUERY", lambda m: {"type": "QUERY", "ready": s.reservations.done()}
         )
         s.register_callback("EXEC_CONFIG", self._exec_config_callback)
+        # full cluster spec (reference TensorflowServer RESERVATIONS verb,
+        # rpc.py:614-620)
+        s.register_callback(
+            "RESERVATIONS",
+            lambda m: {
+                "type": "RESERVATIONS",
+                "cluster": s.reservations.cluster_spec(),
+            },
+        )
         s.register_callback("METRIC", self._metric_callback)
         s.register_callback("FINAL", self._final_callback)
         s.register_callback("GET", lambda m: {"type": "GSTOP"})
@@ -53,13 +71,20 @@ class DistributedTrainingDriver(Driver):
             "LOG", lambda m: {"type": "LOG", "logs": self.drain_logs(), "progress": ""}
         )
 
+    def _touch(self, pid: int) -> None:
+        with self.lock:
+            self._last_seen[pid] = time.time()
+
     def _reg_callback(self, msg) -> Dict[str, Any]:
         self.server.reservations.register(msg["partition_id"], msg.get("meta", {}))
+        self._touch(msg["partition_id"])
         return {"type": "OK"}
 
     def _exec_config_callback(self, msg) -> Dict[str, Any]:
         # worker 0's host becomes the jax.distributed coordinator
-        # (the reference's MASTER_ADDR selection, rpc.py:544-553)
+        # (the reference's MASTER_ADDR selection, rpc.py:544-553); app/run ids
+        # ride along so pod workers land their artifacts in the driver's
+        # experiment directory
         spec = self.server.reservations.cluster_spec()
         coordinator = None
         if self.num_executors > 1 and spec:
@@ -70,13 +95,19 @@ class DistributedTrainingDriver(Driver):
             "num_processes": self.num_executors,
             "coordinator": coordinator,
             "cluster": spec,
+            "app_id": self.app_id,
+            "run_id": self.run_id,
         }
 
     def _metric_callback(self, msg) -> Dict[str, Any]:
+        self._touch(msg["partition_id"])
         self.server.enqueue(msg)
         return {"type": "STOP"} if self.abort.is_set() else {"type": "OK"}
 
     def _final_callback(self, msg) -> Dict[str, Any]:
+        with self.lock:
+            self._final_pids.add(msg["partition_id"])
+        self._touch(msg["partition_id"])
         self.server.enqueue(msg)
         return {"type": "OK"}
 
@@ -124,11 +155,53 @@ class DistributedTrainingDriver(Driver):
 
     # ------------------------------------------------------------------ executor
 
+    def _local_partitions(self) -> List[int]:
+        if not self.pod_mode:
+            return super()._local_partitions()
+        import socket as socket_mod
+
+        # reachable hostname, not the loopback the Server records for 0.0.0.0
+        # binds — launcher tooling copies this into MAGGY_TPU_DRIVER
+        self.log(
+            f"Pod mode: driver at {socket_mod.gethostname()}:{self.server.port} "
+            f"(secret via MAGGY_TPU_SECRET), running local partition 0, "
+            f"awaiting {self.num_executors - 1} remote workers"
+        )
+        return [0]
+
     def _await_completion(self) -> None:
         super()._await_completion()
         # workers exit right after FINAL is *enqueued*; wait for the digestion
         # thread to actually aggregate before run_experiment reads self.result
-        if self.exception is None and not self.abort.is_set():
+        if self.exception is not None or self.abort.is_set():
+            return
+        if self.pod_mode:
+            # remote workers may train for hours: wait for every FINAL, but a
+            # registered worker that goes silent past worker_timeout (its
+            # heartbeat beats every hb_interval) fails the run loudly instead
+            # of hanging the driver forever
+            timeout = getattr(self.config, "worker_timeout", 1800.0)
+            while not self.experiment_done.wait(timeout=1.0):
+                if self.abort.is_set():
+                    return
+                now = time.time()
+                with self.lock:
+                    stale = [
+                        pid
+                        for pid, ts in self._last_seen.items()
+                        if now - ts > timeout and pid not in self._final_pids
+                    ]
+                if stale:
+                    with self.lock:
+                        if self.exception is None:
+                            self.exception = RuntimeError(
+                                f"Pod worker(s) {stale} silent for more than "
+                                f"{timeout:.0f}s; aborting experiment."
+                            )
+                    self.abort.set()
+                    self.experiment_done.set()
+                    return
+        else:
             self.experiment_done.wait(timeout=60)
 
     def _device_groups(self) -> List[list]:

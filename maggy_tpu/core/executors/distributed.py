@@ -98,30 +98,53 @@ def dist_executor_fn(
             reporter.close()
 
     def _build_context(exec_config, config):
+        import jax
+
         from maggy_tpu.train.trainer import TrainContext
 
         num_processes = exec_config.get("num_processes", 1)
-        if num_processes > 1 and exec_config.get("coordinator"):
+        data_plane = getattr(config, "data_plane", "auto")
+        mesh_devices = devices if devices else None
+        if data_plane == "auto" and num_processes > 1 and exec_config.get("coordinator"):
             # Multi-host pod bootstrap (replaces MASTER_ADDR/NCCL rendezvous,
-            # reference torch_dist_executor.py:121-140).
-            import jax
-
-            jax.distributed.initialize(
-                coordinator_address=exec_config["coordinator"],
-                num_processes=num_processes,
-                process_id=partition_id,
-            )
+            # reference torch_dist_executor.py:121-140). jax.distributed must
+            # run before any backend use; probing jax.process_count() here
+            # would itself initialize the backend, so check initialization
+            # state directly and fail LOUDLY when it is too late — silently
+            # unsynchronized replicas are worse than an error.
+            if _jax_backend_initialized() and jax.process_count() == 1:
+                raise RuntimeError(
+                    "data_plane='auto' on a multi-worker pod requires "
+                    "jax.distributed.initialize() before any JAX computation "
+                    "(call it at the top of your script or via the launcher), "
+                    "or pass DistributedConfig(data_plane='local') for "
+                    "independent per-host replicas."
+                )
+            if not _jax_backend_initialized():
+                jax.distributed.initialize(
+                    coordinator_address=exec_config["coordinator"],
+                    num_processes=num_processes,
+                    process_id=partition_id,
+                )
             mesh_devices = None  # global pod mesh
-        else:
-            # several local workers: honor this worker's device lease
-            mesh_devices = devices if devices else None
-        import jax
+        elif data_plane == "auto" and jax.process_count() > 1:
+            mesh_devices = None  # launcher-formed global mesh
 
         n = len(mesh_devices) if mesh_devices is not None else len(jax.devices())
         spec = config.resolve_sharding(n)
         return TrainContext.create(spec, devices=mesh_devices)
 
     return _executor
+
+
+def _jax_backend_initialized() -> bool:
+    """True if XLA backends already exist (without creating them)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # internal API moved — assume initialized (safe side)
+        return True
 
 
 def _seed_key(seed: int):

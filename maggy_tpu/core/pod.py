@@ -1,0 +1,96 @@
+"""TPU-VM pod execution: every host runs the same user script.
+
+The reference ships pickled closures to Spark executors over the JVM
+(spark_driver.py:136-145). On a TPU pod that machinery is unnecessary — the
+standard JAX SPMD launch already starts one identical Python process per host,
+so the train_fn exists everywhere by construction. ``lagom(train_fn,
+DistributedConfig(...))`` therefore behaves per role:
+
+* **process 0** (or single-host): full driver + its own worker — unchanged.
+* **process k > 0** (detected via ``worker_role()``): skip the driver, connect
+  a worker to the process-0 driver over the host network, run the executor,
+  and return the local outputs.
+
+The driver address travels out-of-band (it is known before Python starts):
+``MAGGY_TPU_DRIVER=host:port`` + ``MAGGY_TPU_SECRET=...`` env vars, or
+``DistributedConfig(driver_addr=...)`` with the secret read from env. Port and
+secret are printed by the driver at startup for launcher tooling.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional, Tuple
+
+
+def worker_role(config) -> Optional[Tuple[str, int, str]]:
+    """Return (host, port, secret) if this process should run as a pod worker,
+    else None (run the driver)."""
+    addr = os.environ.get("MAGGY_TPU_DRIVER") or getattr(config, "driver_addr", None)
+    if not addr:
+        return None
+    explicit_role = os.environ.get("MAGGY_TPU_ROLE")
+    if explicit_role == "driver":
+        return None
+    if explicit_role != "worker":
+        # infer from the JAX process index: process 0 hosts the driver
+        try:
+            import jax
+
+            if jax.process_index() == 0:
+                return None
+        except Exception:
+            return None
+    secret = os.environ.get("MAGGY_TPU_SECRET", "")
+    if not secret:
+        raise RuntimeError(
+            "Pod worker role needs MAGGY_TPU_SECRET (printed by the driver)."
+        )
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port), secret
+
+
+def partition_id() -> int:
+    if "MAGGY_TPU_PARTITION" in os.environ:
+        return int(os.environ["MAGGY_TPU_PARTITION"])
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def run_worker(
+    train_fn: Callable, config, host: str, port: int, secret: str
+) -> Any:
+    """Run this process as one pod worker; returns the worker's outputs."""
+    from maggy_tpu import util
+    from maggy_tpu.core import rpc
+    from maggy_tpu.core.executors.distributed import dist_executor_fn
+
+    # pre-flight: fetch the driver's app/run ids so this worker's artifacts
+    # land in the driver's experiment directory (env vars override)
+    app_id = os.environ.get("MAGGY_TPU_APP_ID")
+    run_id = os.environ.get("MAGGY_TPU_RUN_ID")
+    if app_id is None or run_id is None:
+        probe = rpc.Client((host, port), partition_id(), secret)
+        try:
+            cfg_reply = probe._request({"type": "EXEC_CONFIG"})
+            app_id = app_id or cfg_reply.get("app_id") or util.new_app_id()
+            run_id = run_id or cfg_reply.get("run_id") or 1
+        finally:
+            probe.stop()
+    run_id = int(run_id)
+    executor = dist_executor_fn(
+        train_fn=train_fn,
+        config=config,
+        app_id=app_id,
+        run_id=run_id,
+        partition_id=partition_id(),
+        server_addr=(host, port),
+        secret=secret,
+        devices=None,  # pod worker spans its host's devices
+    )
+    executor()
+    return {"role": "worker", "partition_id": partition_id()}

@@ -31,6 +31,8 @@ APP_ID: Optional[str] = None
 RUN_ID: int = 0
 _running_lock = threading.Lock()
 _running = False
+# the driver currently executing (monitoring/launcher introspection)
+CURRENT_DRIVER = None
 
 
 def lagom(train_fn: Callable, config: LagomConfig) -> Any:
@@ -50,11 +52,19 @@ def lagom(train_fn: Callable, config: LagomConfig) -> Any:
             )
         _running = True
     try:
+        worker_result = _maybe_run_as_pod_worker(train_fn, config)
+        if worker_result is not None:
+            return worker_result
         if APP_ID is None:
             APP_ID = util.new_app_id()
         RUN_ID = util.RUNS.next_run_id(APP_ID)
         driver = lagom_driver(config, APP_ID, RUN_ID)
-        return driver.run_experiment(train_fn)
+        global CURRENT_DRIVER
+        CURRENT_DRIVER = driver
+        try:
+            return driver.run_experiment(train_fn)
+        finally:
+            CURRENT_DRIVER = None
     finally:
         with _running_lock:
             _running = False
@@ -101,3 +111,17 @@ def _(config: DistributedConfig, app_id: str, run_id: int):
         raise NotImplementedError(f"Distributed driver unavailable: {e}") from e
 
     return DistributedTrainingDriver(config, app_id, run_id)
+
+
+def _maybe_run_as_pod_worker(train_fn: Callable, config) -> Optional[Any]:
+    """Pod mode: non-zero hosts run a worker against the process-0 driver
+    instead of their own driver (core/pod.py)."""
+    if not isinstance(config, DistributedConfig):
+        return None
+    from maggy_tpu.core import pod
+
+    role = pod.worker_role(config)
+    if role is None:
+        return None
+    host, port, secret = role
+    return pod.run_worker(train_fn, config, host, port, secret)

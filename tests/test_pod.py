@@ -1,0 +1,105 @@
+"""Pod-mode control plane: a real second OS process connects to the driver
+over TCP, registers, passes the reservation barrier, trains its own copy of
+the train_fn, and its FINAL is aggregated — the TPU-VM pod execution model
+(every host runs the same script) exercised on localhost."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import experiment
+from maggy_tpu.config import DistributedConfig
+
+WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from maggy_tpu import experiment
+    from maggy_tpu.config import DistributedConfig
+
+    def train(hparams, reporter, ctx):
+        reporter.broadcast(1.0, step=0)
+        return {{"metric": float(hparams["base"]) + 1.0}}
+
+    result = experiment.lagom(
+        train,
+        DistributedConfig(
+            hparams={{"base": 10.0}},
+            num_executors=2,
+            sharding="dp",
+            data_plane="local",
+            hb_interval=0.05,
+        ),
+    )
+    print("WORKER-DONE", result)
+    """
+).format(repo=os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+
+def test_pod_two_process_training(tmp_env, tmp_path):
+    result_holder = {}
+
+    def train(hparams, reporter, ctx):
+        reporter.broadcast(1.0, step=0)
+        return {"metric": float(hparams["base"]) + 1.0}
+
+    config = DistributedConfig(
+        hparams={"base": 10.0},
+        num_executors=2,
+        sharding="dp",
+        data_plane="local",
+        driver_addr="127.0.0.1:auto",  # placeholder: flags pod mode for the driver
+        hb_interval=0.05,
+    )
+
+    def run_driver():
+        result_holder["result"] = experiment.lagom(train, config)
+
+    t = threading.Thread(target=run_driver)
+    t.start()
+
+    # discover the live driver's port + secret (what a pod launcher reads)
+    deadline = time.time() + 30
+    driver = None
+    while time.time() < deadline:
+        driver = experiment.CURRENT_DRIVER
+        if driver is not None and driver.server is not None and driver.server.port:
+            break
+        time.sleep(0.05)
+    assert driver is not None and driver.server is not None, "driver never started"
+    assert driver.pod_mode
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    env = dict(os.environ)
+    env.update(
+        {
+            "MAGGY_TPU_ROLE": "worker",
+            "MAGGY_TPU_DRIVER": f"127.0.0.1:{driver.server.port}",
+            "MAGGY_TPU_SECRET": driver.server.secret,
+            "MAGGY_TPU_PARTITION": "1",
+            "MAGGY_TPU_LOG_ROOT": str(tmp_path / "worker_logs"),
+        }
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script)], env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "WORKER-DONE" in proc.stdout
+    assert "'role': 'worker'" in proc.stdout
+
+    t.join(timeout=60)
+    assert not t.is_alive(), "driver did not finish"
+    result = result_holder["result"]
+    assert result["num_workers"] == 2
+    assert result["metric"] == pytest.approx(11.0)  # both workers returned 11.0
