@@ -13,6 +13,7 @@ pods reuse the same RPC protocol with workers connecting over the host network.
 
 from __future__ import annotations
 
+import atexit
 import logging
 import os
 import queue
@@ -103,6 +104,11 @@ class Driver(ABC):
             f"Starting experiment {self.config.name} "
             f"({type(self).__name__}, {self.num_executors} executors)"
         )
+        # experiment state metadata: RUNNING -> FINISHED/FAILED, KILLED on
+        # interpreter death (reference atexit/except hooks,
+        # experiment_pyspark.py:149-183)
+        self._write_state("RUNNING")
+        atexit.register(self._kill_hook)
         try:
             self._exp_startup_callback()
             self.init()
@@ -112,9 +118,34 @@ class Driver(ABC):
                 raise self.exception
             self._exp_final_callback()
             self.duration = time.time() - self.job_start
+            self._write_state("FINISHED")
             return self.result
+        except BaseException:
+            self._write_state("FAILED")
+            raise
         finally:
+            atexit.unregister(self._kill_hook)
             self.stop()
+
+    def _write_state(self, state: str) -> None:
+        self._state = state
+        try:
+            self.env.dump(
+                {
+                    "state": state,
+                    "name": self.config.name,
+                    "app_id": self.app_id,
+                    "run_id": self.run_id,
+                    "ts": time.time(),
+                },
+                os.path.join(self.exp_dir, "state.json"),
+            )
+        except OSError:
+            pass
+
+    def _kill_hook(self) -> None:
+        if getattr(self, "_state", None) == "RUNNING":
+            self._write_state("KILLED")
 
     def init(self) -> None:
         self.server = self._make_server()

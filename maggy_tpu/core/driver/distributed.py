@@ -39,9 +39,9 @@ class DistributedTrainingDriver(Driver):
         self._final_pids: set = set()
         # pod mode: remote hosts run their own copy of the script and connect
         # as workers (core/pod.py); this driver launches only partition 0
-        self.pod_mode = bool(
-            os.environ.get("MAGGY_TPU_DRIVER") or getattr(config, "driver_addr", None)
-        )
+        from maggy_tpu.core.pod import driver_address
+
+        self.pod_mode = bool(driver_address(config))
 
     # ------------------------------------------------------------------ server
 
@@ -87,7 +87,9 @@ class DistributedTrainingDriver(Driver):
         # experiment directory
         spec = self.server.reservations.cluster_spec()
         coordinator = None
-        if self.num_executors > 1 and spec:
+        # advertised only on pods — a plain local multi-worker run must not
+        # look like a multi-host cluster to the executors
+        if self.pod_mode and self.num_executors > 1 and spec:
             host = spec[0].get("host") or "127.0.0.1"
             coordinator = f"{host}:{8476}"
         return {
@@ -213,6 +215,10 @@ class DistributedTrainingDriver(Driver):
             devices = jax.local_devices()
         except Exception:
             return [[]]
+        if self.pod_mode:
+            # remote pod workers span their whole host; the driver's local
+            # partition must match, not take a 1/num_executors lease
+            return [devices]
         n = self.num_executors
         if n <= 1 or len(devices) < n:
             return [devices]

@@ -105,46 +105,30 @@ def dist_executor_fn(
         num_processes = exec_config.get("num_processes", 1)
         data_plane = getattr(config, "data_plane", "auto")
         mesh_devices = devices if devices else None
-        if data_plane == "auto" and num_processes > 1 and exec_config.get("coordinator"):
-            # Multi-host pod bootstrap (replaces MASTER_ADDR/NCCL rendezvous,
-            # reference torch_dist_executor.py:121-140). jax.distributed must
-            # run before any backend use; probing jax.process_count() here
-            # would itself initialize the backend, so check initialization
-            # state directly and fail LOUDLY when it is too late — silently
-            # unsynchronized replicas are worse than an error.
-            if _jax_backend_initialized() and jax.process_count() == 1:
+        pod = bool(exec_config.get("coordinator"))  # driver advertises this only in pod mode
+        if data_plane == "auto":
+            if jax.process_count() > 1:
+                mesh_devices = None  # launcher-formed global mesh (§2.9 ICI/DCN)
+            elif pod and num_processes > 1:
+                # The MASTER_ADDR/NCCL-rendezvous moment (reference
+                # torch_dist_executor.py:121-140). By executor time the XLA
+                # backend is long since initialized, so a late
+                # jax.distributed.initialize cannot work — require the
+                # standard JAX practice and fail loudly; silently
+                # unsynchronized replicas would be worse.
                 raise RuntimeError(
-                    "data_plane='auto' on a multi-worker pod requires "
-                    "jax.distributed.initialize() before any JAX computation "
-                    "(call it at the top of your script or via the launcher), "
-                    "or pass DistributedConfig(data_plane='local') for "
-                    "independent per-host replicas."
+                    "data_plane='auto' on a multi-host pod requires "
+                    "jax.distributed.initialize() before lagom() (call it at "
+                    "the top of your script or via the launcher), or pass "
+                    "DistributedConfig(data_plane='local') for independent "
+                    "per-host replicas."
                 )
-            if not _jax_backend_initialized():
-                jax.distributed.initialize(
-                    coordinator_address=exec_config["coordinator"],
-                    num_processes=num_processes,
-                    process_id=partition_id,
-                )
-            mesh_devices = None  # global pod mesh
-        elif data_plane == "auto" and jax.process_count() > 1:
-            mesh_devices = None  # launcher-formed global mesh
 
         n = len(mesh_devices) if mesh_devices is not None else len(jax.devices())
         spec = config.resolve_sharding(n)
         return TrainContext.create(spec, devices=mesh_devices)
 
     return _executor
-
-
-def _jax_backend_initialized() -> bool:
-    """True if XLA backends already exist (without creating them)."""
-    try:
-        from jax._src import xla_bridge
-
-        return bool(xla_bridge._backends)
-    except Exception:  # internal API moved — assume initialized (safe side)
-        return True
 
 
 def _seed_key(seed: int):

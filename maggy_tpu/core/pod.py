@@ -6,59 +6,105 @@ standard JAX SPMD launch already starts one identical Python process per host,
 so the train_fn exists everywhere by construction. ``lagom(train_fn,
 DistributedConfig(...))`` therefore behaves per role:
 
-* **process 0** (or single-host): full driver + its own worker — unchanged.
-* **process k > 0** (detected via ``worker_role()``): skip the driver, connect
-  a worker to the process-0 driver over the host network, run the executor,
-  and return the local outputs.
+* **process 0** (or ``MAGGY_TPU_ROLE=driver``): full driver + its own worker.
+* **worker hosts** (``MAGGY_TPU_ROLE=worker``, or a non-zero
+  ``jax.process_index()``): skip the driver, connect a worker to the process-0
+  driver over the host network, run the executor, return the local outputs.
 
-The driver address travels out-of-band (it is known before Python starts):
-``MAGGY_TPU_DRIVER=host:port`` + ``MAGGY_TPU_SECRET=...`` env vars, or
-``DistributedConfig(driver_addr=...)`` with the secret read from env. Port and
-secret are printed by the driver at startup for launcher tooling.
+Bootstrap contract: on a pod with ``data_plane="auto"`` the launcher (or the
+top of the user script) calls ``jax.distributed.initialize()`` — standard JAX
+practice — *before* ``lagom``. The framework never initializes it late (the
+backend is already up by the time executors run) and fails loudly instead of
+silently training unsynchronized replicas. The driver address travels
+out-of-band: ``MAGGY_TPU_DRIVER=host:port`` + ``MAGGY_TPU_SECRET=...`` env
+vars, or ``DistributedConfig(driver_addr=...)``; the driver logs its reachable
+address at startup for launcher tooling.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Optional, Tuple
+
+
+def jax_backend_initialized() -> bool:
+    """True if XLA backends already exist (without creating them)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # internal API moved — assume initialized (safe side)
+        return True
+
+
+def driver_address(config) -> Optional[str]:
+    """The single source of pod-mode detection for driver AND workers."""
+    return os.environ.get("MAGGY_TPU_DRIVER") or getattr(config, "driver_addr", None)
+
+
+def _parse_addr(addr: str) -> Tuple[str, int]:
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"MAGGY_TPU_DRIVER/driver_addr must be 'host:port', got {addr!r}"
+        )
+    return host or "127.0.0.1", int(port)
 
 
 def worker_role(config) -> Optional[Tuple[str, int, str]]:
     """Return (host, port, secret) if this process should run as a pod worker,
     else None (run the driver)."""
-    addr = os.environ.get("MAGGY_TPU_DRIVER") or getattr(config, "driver_addr", None)
+    addr = driver_address(config)
     if not addr:
         return None
     explicit_role = os.environ.get("MAGGY_TPU_ROLE")
     if explicit_role == "driver":
         return None
     if explicit_role != "worker":
-        # infer from the JAX process index: process 0 hosts the driver
-        try:
-            import jax
+        # Infer from the JAX process index. Meaningful only when
+        # jax.distributed is already up; a single-process backend (dev box,
+        # driver host in tests) infers "driver". A real pod must therefore
+        # either initialize jax.distributed before lagom() or set
+        # MAGGY_TPU_ROLE per host — otherwise every host becomes a driver and
+        # the run fails loudly at the reservation barrier.
+        import jax
 
-            if jax.process_index() == 0:
-                return None
-        except Exception:
+        if jax.process_index() == 0:
             return None
     secret = os.environ.get("MAGGY_TPU_SECRET", "")
     if not secret:
         raise RuntimeError(
             "Pod worker role needs MAGGY_TPU_SECRET (printed by the driver)."
         )
-    host, _, port = addr.rpartition(":")
-    return host or "127.0.0.1", int(port), secret
+    host, port = _parse_addr(addr)
+    return host, port, secret
 
 
 def partition_id() -> int:
     if "MAGGY_TPU_PARTITION" in os.environ:
         return int(os.environ["MAGGY_TPU_PARTITION"])
-    try:
-        import jax
+    import jax
 
-        return jax.process_index()
-    except Exception:
-        return 0
+    return jax.process_index()
+
+
+def _connect_with_deadline(host: str, port: int, pid: int, secret: str, deadline_s: float):
+    """Pod hosts start simultaneously; the driver may need many seconds of JAX
+    bring-up before it listens — retry well past Client's own 3 attempts."""
+    from maggy_tpu.core import rpc
+    from maggy_tpu.exceptions import RpcError
+
+    deadline = time.time() + deadline_s
+    delay = 0.2
+    while True:
+        try:
+            return rpc.Client((host, port), pid, secret)
+        except RpcError:
+            if time.time() > deadline:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 1.5, 5.0)
 
 
 def run_worker(
@@ -66,15 +112,17 @@ def run_worker(
 ) -> Any:
     """Run this process as one pod worker; returns the worker's outputs."""
     from maggy_tpu import util
-    from maggy_tpu.core import rpc
     from maggy_tpu.core.executors.distributed import dist_executor_fn
+
+    pid = partition_id()
+    connect_timeout = float(os.environ.get("MAGGY_TPU_CONNECT_TIMEOUT", "120"))
 
     # pre-flight: fetch the driver's app/run ids so this worker's artifacts
     # land in the driver's experiment directory (env vars override)
     app_id = os.environ.get("MAGGY_TPU_APP_ID")
     run_id = os.environ.get("MAGGY_TPU_RUN_ID")
     if app_id is None or run_id is None:
-        probe = rpc.Client((host, port), partition_id(), secret)
+        probe = _connect_with_deadline(host, port, pid, secret, connect_timeout)
         try:
             cfg_reply = probe._request({"type": "EXEC_CONFIG"})
             app_id = app_id or cfg_reply.get("app_id") or util.new_app_id()
@@ -87,10 +135,10 @@ def run_worker(
         config=config,
         app_id=app_id,
         run_id=run_id,
-        partition_id=partition_id(),
+        partition_id=pid,
         server_addr=(host, port),
         secret=secret,
         devices=None,  # pod worker spans its host's devices
     )
     executor()
-    return {"role": "worker", "partition_id": partition_id()}
+    return {"role": "worker", "partition_id": pid}

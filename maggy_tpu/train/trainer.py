@@ -184,28 +184,30 @@ class Trainer:
         profiling = False
         prof_start = min(profile_steps[0], max(0, num_steps - 2))
         prof_stop = min(profile_steps[1], num_steps - 1)
-        for i in range(num_steps):
-            if profile_dir is not None and not profiling and i == prof_start:
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-            batch = next(data_iter)
-            state, metrics = self.step(state, self.shard_batch(batch))
-            if profiling and i >= prof_stop:
-                jax.block_until_ready(metrics[metric_key])
+        try:
+            for i in range(num_steps):
+                if profile_dir is not None and not profiling and i == prof_start:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                batch = next(data_iter)
+                state, metrics = self.step(state, self.shard_batch(batch))
+                if profiling and i >= prof_stop:
+                    jax.block_until_ready(metrics)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    profile_dir = None  # one capture per fit
+                if reporter is not None and (i + 1) % report_every == 0:
+                    value = float(metrics[metric_key])
+                    reporter.broadcast(
+                        -value if metric_key == "loss" else value, step=int(state.step)
+                    )
+                if checkpointer is not None and checkpoint_every and (
+                    (i + 1) % checkpoint_every == 0
+                ):
+                    checkpointer.save(int(state.step), state)
+        finally:
+            if profiling:  # loop ended/raised while a trace was active
                 jax.profiler.stop_trace()
-                profiling = False
-                profile_dir = None  # one capture per fit
-            if reporter is not None and (i + 1) % report_every == 0:
-                value = float(metrics[metric_key])
-                reporter.broadcast(
-                    -value if metric_key == "loss" else value, step=int(state.step)
-                )
-            if checkpointer is not None and checkpoint_every and (
-                (i + 1) % checkpoint_every == 0
-            ):
-                checkpointer.save(int(state.step), state)
-        if profiling:  # loop ended while a trace was active
-            jax.profiler.stop_trace()
         return state, {k: float(v) for k, v in metrics.items()}
 
 

@@ -1,0 +1,131 @@
+"""Failure-detection paths: lost-trial reassignment on worker restart
+(reference rpc.py:415-437), experiment state metadata, stale-worker abort."""
+
+import json
+import os
+import time
+
+import pytest
+
+from maggy_tpu import Searchspace, experiment
+from maggy_tpu.config import HyperparameterOptConfig
+from maggy_tpu.core import rpc
+from maggy_tpu.core.driver.hpo import HyperparameterOptDriver
+from maggy_tpu.trial import Trial
+
+
+def make_driver(tmp_env, num_trials=4):
+    cfg = HyperparameterOptConfig(
+        num_trials=num_trials,
+        optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0.0, 1.0])),
+        num_executors=2,
+        es_policy="none",
+        hb_interval=0.05,
+        seed=0,
+    )
+    return HyperparameterOptDriver(cfg, "app_fault", 1)
+
+
+def test_lost_trial_marked_error_and_rescheduled(tmp_env):
+    """A worker re-registration (new attempt nonce) with an in-flight trial
+    must mark that trial ERROR and hand the partition a fresh one."""
+    driver = make_driver(tmp_env)
+    driver.server = driver._make_server()
+    driver._register_msg_callbacks()
+
+    # initial registration + assignment
+    driver.server.reservations.register(0, {"attempt": "a1"})
+    driver._digest_reg({"type": "REG", "partition_id": 0, "reregistered": False})
+    first = driver.server.reservations.get_assignment(0)
+    assert first is not None
+    assert driver.trial_store[first].status == Trial.SCHEDULED
+
+    # same worker instance retries REG -> NOT a restart
+    assert not driver.server.reservations.register(0, {"attempt": "a1"})
+
+    # a new instance (restart) takes the partition
+    assert driver.server.reservations.register(0, {"attempt": "a2"})
+    driver._digest_reg({"type": "REG", "partition_id": 0, "reregistered": True})
+
+    lost = [t for t in driver.final_store if t.trial_id == first]
+    assert len(lost) == 1 and lost[0].status == Trial.ERROR
+    second = driver.server.reservations.get_assignment(0)
+    assert second is not None and second != first
+    # the lost trial persisted like any other
+    assert os.path.exists(
+        os.path.join(tmp_env.experiment_dir("app_fault", 1), first, "trial.json")
+    )
+
+
+def test_experiment_state_lifecycle(tmp_env):
+    def train(hparams):
+        return hparams["x"]
+
+    cfg = HyperparameterOptConfig(
+        num_trials=2, optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0, 1])),
+        num_executors=1, es_policy="none", hb_interval=0.05,
+    )
+    experiment.lagom(train, cfg)
+    exp_dir = tmp_env.experiment_dir(experiment.APP_ID, experiment.RUN_ID)
+    state = json.load(open(os.path.join(exp_dir, "state.json")))
+    assert state["state"] == "FINISHED"
+
+    with pytest.raises(RuntimeError):
+        experiment.lagom(lambda hparams: (_ for _ in ()).throw(RuntimeError("x")), cfg)
+    exp_dir = tmp_env.experiment_dir(experiment.APP_ID, experiment.RUN_ID)
+    state = json.load(open(os.path.join(exp_dir, "state.json")))
+    assert state["state"] == "FAILED"
+
+
+def test_log_verb_serves_progress(tmp_env):
+    """The LOG channel (sparkmagic/jupyter monitor parity, rpc.py:490-502)."""
+    import threading
+
+    progress_seen = []
+
+    def train(hparams, reporter):
+        reporter.log("working hard")
+        time.sleep(0.2)
+        return hparams["x"]
+
+    cfg = HyperparameterOptConfig(
+        num_trials=3, optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0, 1])),
+        num_executors=1, es_policy="none", hb_interval=0.05, seed=1,
+    )
+
+    def monitor():
+        deadline = time.time() + 20
+        client = None
+        while time.time() < deadline:
+            driver = experiment.CURRENT_DRIVER
+            if driver is not None and driver.server is not None and driver.server.port:
+                try:
+                    client = rpc.Client(
+                        (driver.server.host, driver.server.port), 99, driver.server.secret
+                    )
+                    break
+                except Exception:
+                    time.sleep(0.05)
+            time.sleep(0.02)
+        if client is None:
+            return
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                reply = client._request({"type": "LOG"})
+            except Exception:
+                break
+            if reply.get("progress"):
+                progress_seen.append(reply["progress"])
+            time.sleep(0.05)
+        client.stop()
+
+    t = threading.Thread(target=monitor, daemon=True)
+    t.start()
+    experiment.lagom(train, cfg)
+    t.join(timeout=2)
+    assert progress_seen  # monitor observed live progress strings
+    assert any("3" in p for p in progress_seen)
