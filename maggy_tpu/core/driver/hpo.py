@@ -300,6 +300,11 @@ class HyperparameterOptDriver(Driver):
                 self.trial_store[suggestion.trial_id] = suggestion
             self.server.reservations.assign_trial(pid, suggestion.trial_id)
             self._maybe_idle.discard(pid)
+            self._controller_log(
+                f"{suggestion.info_dict.get('sample_type', '?')} trial "
+                f"{suggestion.trial_id} -> executor {pid} "
+                f"budget={suggestion.params.get('budget')}"
+            )
         elif suggestion == IDLE:
             self._maybe_idle.add(pid)
         else:  # None: optimizer exhausted
@@ -379,6 +384,17 @@ class HyperparameterOptDriver(Driver):
     def progress(self) -> str:
         with self.lock:
             return util.progress_bar(len(self.final_store), self.num_trials)
+
+    def _controller_log(self, message: str) -> None:
+        """Controller decision log (reference optimizer.log/pruner.log,
+        abstractoptimizer.py:84-134 + abstractpruner.py:72-85)."""
+        try:
+            with self.env.open_file(
+                os.path.join(self.exp_dir, "optimizer.log"), "a"
+            ) as f:
+                f.write(f"[{time.strftime('%H:%M:%S')}] {message}\n")
+        except OSError:
+            pass
 
     # ------------------------------------------------------------------ executor
 

@@ -1,0 +1,63 @@
+"""Live experiment monitor — the jupyter/sparkmagic LOG-polling client
+(reference rpc.py:490-502 + optimization_driver.py:412-431) as a CLI:
+
+    python -m maggy_tpu.monitor <host:port> <secret> [--interval 1.0]
+
+Polls the driver's LOG verb, printing shipped log lines and the progress bar.
+Works against any running experiment (the driver logs its address at startup;
+in-process, ``experiment.CURRENT_DRIVER.server`` has host/port/secret).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def monitor(host: str, port: int, secret: str, interval: float = 1.0) -> int:
+    from maggy_tpu.core import rpc
+    from maggy_tpu.exceptions import RpcError
+
+    client = rpc.Client((host, port), partition_id=-1, secret=secret)
+    last_progress = ""
+    try:
+        while True:
+            try:
+                reply = client._request({"type": "LOG"})
+            except RpcError as e:
+                if "rejected" in str(e):
+                    print(f"[monitor] {e}", flush=True)  # e.g. bad secret
+                    return 1
+                print("[monitor] driver gone; exiting", flush=True)
+                return 0
+            for line in reply.get("logs") or []:
+                print(line, flush=True)
+            progress = reply.get("progress") or ""
+            if progress and progress != last_progress:
+                print(progress, flush=True)
+                last_progress = progress
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("addr", help="driver host:port")
+    parser.add_argument("secret", help="experiment secret")
+    parser.add_argument("--interval", type=float, default=1.0)
+    args = parser.parse_args(argv)
+    from maggy_tpu.core.pod import _parse_addr
+
+    try:
+        host, port = _parse_addr(args.addr)
+    except ValueError as e:
+        parser.error(str(e))
+    return monitor(host, port, args.secret, args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""Pipeline parallelism: GPipe-style fill/drain schedule over the ``stage`` axis.
+
+The reference explicitly rejects pipeline modules (core/patching/modules.py:
+106-109 asserts against DeepSpeed PipelineModule); SURVEY.md §2.10 marks PP a
+stretch goal. This is the TPU-native version: layer stages live on different
+devices along the ``stage`` mesh axis, activations flow stage→stage via
+``ppermute`` (point-to-point — DCN-friendly, hence the axis sits outermost in
+MESH_AXES), and microbatches keep every stage busy after the fill phase.
+
+Schedule (classic GPipe, no 1F1B): with S stages and M microbatches the loop
+runs M + S - 1 ticks; at tick t stage s processes microbatch t - s. Backward
+flows through the same schedule by autodiff (ppermute's transpose is the
+reverse permute), so one ``jax.grad`` around :func:`pipeline_apply` trains the
+whole pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from maggy_tpu.parallel.spec import AXIS_DATA, AXIS_FSDP, AXIS_STAGE
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    *,
+    mesh,
+    axis_name: str = AXIS_STAGE,
+):
+    """Run a layer pipeline over the mesh's ``stage`` axis.
+
+    :param stage_fn: ``fn(params_for_one_stage, x) -> y`` — one stage's compute
+        (e.g. a scan over its layer chunk). Must keep the activation shape.
+    :param stage_params: pytree whose leaves have a leading ``[n_stages]`` axis
+        (sharded over ``stage``) — build with :func:`stack_stage_params`.
+    :param microbatches: ``[n_micro, mb, ...]`` activations; the ``mb`` axis is
+        sharded over (data, fsdp), so a pp x dp mesh pipelines AND
+        data-parallelizes (each dp replica pipelines its batch slice).
+    :returns: ``[n_micro, mb, ...]`` outputs of the final stage.
+    """
+    n_stages = mesh.shape[axis_name]
+    if n_stages == 1:
+        return jax.vmap(lambda x: stage_fn(jax.tree.map(lambda p: p[0], stage_params), x))(
+            microbatches
+        )
+    n_micro = microbatches.shape[0]
+    if n_micro < n_stages:
+        raise ValueError(
+            f"Need at least as many microbatches ({n_micro}) as stages "
+            f"({n_stages}) to fill the pipeline."
+        )
+
+    def local(params, mb):
+        # params leaves: [1, ...] local stage shard; mb: [n_micro, mb, ...]
+        params = jax.tree.map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis_name)
+        fwd = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            incoming, outputs = carry
+            # stage 0 ingests microbatch t (clamped; masked out later)
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            x0 = jax.lax.dynamic_index_in_dim(mb, mb_idx, keepdims=False)
+            x = jnp.where(stage == 0, x0, incoming)
+            y = stage_fn(params, x)
+            # last stage writes its result for microbatch t - (S-1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            valid = (t >= n_stages - 1) & (stage == n_stages - 1)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, y, jax.lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)),
+                out_idx, 0,
+            )
+            nxt = jax.lax.ppermute(y, axis_name, fwd)
+            return (nxt, updated), None
+
+        init = (jnp.zeros_like(mb[0]), jnp.zeros_like(mb))
+        (_, outputs), _ = jax.lax.scan(
+            tick, init, jnp.arange(n_micro + n_stages - 1)
+        )
+        # only the last stage holds real outputs; psum broadcasts them
+        outputs = jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs))
+        return jax.lax.psum(outputs, axis_name)
+
+    batch_spec = P(None, (AXIS_DATA, AXIS_FSDP))
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis_name), batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )(stage_params, microbatches)
+
+
+def stack_stage_params(per_layer_params, n_stages: int):
+    """Reshape layer-stacked params ``[L, ...]`` into ``[n_stages, L//n_stages,
+    ...]`` for :func:`pipeline_apply` (shard the leading axis over 'stage')."""
+
+    def reshape(p):
+        L = p.shape[0]
+        if L % n_stages:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return p.reshape(n_stages, L // n_stages, *p.shape[1:])
+
+    return jax.tree.map(reshape, per_layer_params)

@@ -15,15 +15,18 @@ import dataclasses
 from typing import Optional, Tuple
 
 # Canonical mesh-axis names, in layout-priority order. ICI-heavy axes (tensor, seq)
-# should map to the innermost/physically-closest devices; `data` is outermost so
-# gradient all-reduce can ride DCN across slices if needed (scaling-book recipe).
+# should map to the innermost/physically-closest devices; `stage` (pipeline:
+# point-to-point once per microbatch) and `data` (one gradient all-reduce per
+# step) are outermost so their traffic can ride DCN across slices
+# (scaling-book recipe).
+AXIS_STAGE = "stage"
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
 
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+MESH_AXES = (AXIS_STAGE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +40,9 @@ class ShardingSpec:
     ``sp``    sequence/context parallelism (ring attention; absent in reference,
               SURVEY.md §5.7)
     ``ep``    expert parallelism for MoE (absent in reference, §2.10)
+    ``pp``    pipeline parallelism over layer stages (the reference explicitly
+              rejects it, modules.py:106-109; provided here as
+              parallel/pipeline.py)
     """
 
     dp: int = 1
@@ -44,6 +50,7 @@ class ShardingSpec:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     def __post_init__(self):
         for f in dataclasses.fields(self):
@@ -53,10 +60,10 @@ class ShardingSpec:
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
 
     def axis_sizes(self) -> Tuple[int, ...]:
-        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+        return (self.pp, self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
     @classmethod
     def preset(cls, name: str, num_devices: int) -> "ShardingSpec":
@@ -75,6 +82,8 @@ class ShardingSpec:
             return cls(tp=n)
         if name == "sp":
             return cls(sp=n)
+        if name == "pp":
+            return cls(pp=n)
         if name == "2d":
             tp = _largest_factor_leq(n, max(1, int(n**0.5)))
             return cls(fsdp=n // tp, tp=tp)
@@ -85,7 +94,7 @@ class ShardingSpec:
 
     def scaled_to(self, num_devices: int) -> "ShardingSpec":
         """Grow/shrink the dp axis so the spec covers exactly ``num_devices``."""
-        rest = self.fsdp * self.tp * self.sp * self.ep
+        rest = self.fsdp * self.tp * self.sp * self.ep * self.pp
         if num_devices % rest != 0:
             raise ValueError(
                 f"{num_devices} devices not divisible by non-dp axes product {rest}"

@@ -19,7 +19,9 @@ from maggy_tpu.train.data import synthetic_lm_batches
 def test_make_mesh_axes():
     spec = ShardingSpec(dp=2, fsdp=2, tp=2)
     mesh = make_mesh(spec)
-    assert mesh.shape == {"data": 2, "fsdp": 2, "expert": 1, "seq": 1, "tensor": 2}
+    assert mesh.shape == {
+        "stage": 1, "data": 2, "fsdp": 2, "expert": 1, "seq": 1, "tensor": 2,
+    }
     with pytest.raises(ValueError):
         make_mesh(ShardingSpec(dp=3))
 

@@ -1,0 +1,118 @@
+"""Protocol/serialization robustness: malformed frames, oversized frames,
+garbage JSON, trial round-trip fuzz, monitor CLI."""
+
+import json
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from maggy_tpu import Searchspace, Trial
+from maggy_tpu.core import rpc
+
+
+@pytest.fixture()
+def server():
+    s = rpc.Server(num_executors=1)
+    s.register_callback("PING", lambda m: {"type": "PING"})
+    s.start(host="127.0.0.1")
+    yield s
+    s.stop()
+
+
+def raw_socket(server):
+    return socket.create_connection((server.host, server.port), timeout=5)
+
+
+def test_garbage_bytes_do_not_kill_server(server):
+    sock = raw_socket(server)
+    sock.sendall(b"\x00\x00\x00\x05nojso")  # length frame, invalid JSON
+    sock.close()
+    # server still answers a well-formed client
+    c = rpc.Client((server.host, server.port), 0, server.secret)
+    assert c._request({"type": "PING"})["type"] == "PING"
+    c.stop()
+
+
+def test_oversized_frame_disconnects_cleanly(server):
+    sock = raw_socket(server)
+    sock.sendall(struct.pack(">I", 1 << 30))  # announces a 1 GiB frame
+    # server must drop the connection without allocating
+    sock.settimeout(5)
+    assert sock.recv(4) == b""  # closed
+    sock.close()
+    c = rpc.Client((server.host, server.port), 0, server.secret)
+    assert c._request({"type": "PING"})["type"] == "PING"
+    c.stop()
+
+
+def test_non_dict_payload(server):
+    sock = raw_socket(server)
+    payload = json.dumps([1, 2, 3]).encode()
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    sock.settimeout(5)
+    # either an ERR reply or a clean disconnect — never a hang/crash
+    try:
+        header = sock.recv(4)
+        if header:
+            (length,) = struct.unpack(">I", header)
+            reply = json.loads(sock.recv(length))
+            assert reply["type"] == "ERR"
+    except OSError:
+        pass
+    sock.close()
+    c = rpc.Client((server.host, server.port), 0, server.secret)
+    assert c._request({"type": "PING"})["type"] == "PING"
+    c.stop()
+
+
+def test_trial_roundtrip_fuzz():
+    rng = np.random.default_rng(0)
+    sp = Searchspace(
+        a=("DOUBLE", [-10.0, 10.0]),
+        b=("INTEGER", [-5, 5]),
+        c=("CATEGORICAL", ["x", "y", "z"]),
+    )
+    for _ in range(50):
+        t = Trial(sp.sample())
+        for s in range(rng.integers(0, 5)):
+            t.append_metric(float(rng.normal()), step=s)
+        if rng.random() < 0.5:
+            t.finalize(float(rng.normal()))
+        t2 = Trial.from_json(t.to_json())
+        assert t2.trial_id == t.trial_id
+        assert t2.metric_history == t.metric_history
+        assert t2.status == t.status
+
+
+def test_monitor_cli_against_live_server(server, capsys):
+    """monitor's one-shot poll path: drain a LOG reply and exit on server stop."""
+    server.register_callback(
+        "LOG", lambda m: {"type": "LOG", "logs": ["hello-from-driver"], "progress": "[=>] 1/2"}
+    )
+    import threading
+    import time
+
+    from maggy_tpu import monitor as monitor_mod
+
+    t = threading.Thread(
+        target=monitor_mod.monitor,
+        args=(server.host, server.port, server.secret, 0.05),
+        daemon=True,
+    )
+    t.start()
+    time.sleep(0.5)
+    server.stop()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    out = capsys.readouterr().out
+    assert "hello-from-driver" in out
+    assert "1/2" in out
+
+
+def test_monitor_cli_arg_validation():
+    from maggy_tpu.monitor import main
+
+    with pytest.raises(SystemExit):
+        main(["no-port", "secret"])
