@@ -37,7 +37,6 @@ def count_params(tree) -> int:
 
 def bench_training_throughput(quick: bool = False):
     import jax
-    import numpy as np
     import optax
 
     from maggy_tpu.models import Decoder, DecoderConfig
@@ -110,7 +109,6 @@ def bench_asha_trials_per_hour(quick: bool = False):
     """Trials/hour through the full control plane (driver+RPC+executors) with a
     near-zero-cost train_fn — measures scheduling overhead, the quantity the
     reference's async design optimizes (BASELINE.json primary metric)."""
-    import os
     import tempfile
 
     from maggy_tpu import Searchspace, experiment

@@ -9,7 +9,6 @@ callable for step loops; Keras variants are provided when TF is importable.
 
 from __future__ import annotations
 
-from typing import Optional
 
 
 class ReporterCallback:

@@ -8,7 +8,7 @@ that replace Spark's executor count as the trial-parallelism control.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Union
+from typing import Any, Optional, Union
 
 from maggy_tpu.config.base import LagomConfig
 from maggy_tpu.searchspace import Searchspace

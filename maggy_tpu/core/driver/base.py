@@ -23,7 +23,6 @@ import traceback
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Dict, List, Optional
 
-from maggy_tpu import util
 from maggy_tpu.core import rpc
 from maggy_tpu.core.env import EnvSing
 

@@ -13,7 +13,6 @@ means exactly one worker spanning all visible devices.
 
 from __future__ import annotations
 
-import os
 import statistics
 import time
 from typing import Any, Callable, Dict, List

@@ -14,11 +14,10 @@ SingleRun optimizer and one executor, returning the train_fn's outputs directly.
 
 from __future__ import annotations
 
-import json
 import os
 import statistics
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 from maggy_tpu import constants, util
 from maggy_tpu.config.base import BaseConfig

@@ -8,7 +8,7 @@ use so local development never needs the dependency.
 from __future__ import annotations
 
 import posixpath
-from typing import Any, List, Optional
+from typing import List, Optional
 
 from maggy_tpu.core.env.base import BaseEnv
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 import os
 import socket as socket_mod
 import traceback
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 from maggy_tpu import constants, util
 from maggy_tpu.core import rpc

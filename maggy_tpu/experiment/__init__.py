@@ -12,9 +12,7 @@ running experiments with just the right amount of resources.
 
 from __future__ import annotations
 
-import atexit
 import threading
-import traceback
 from functools import singledispatch
 from typing import Any, Callable, Optional
 

@@ -12,7 +12,6 @@ cube the Searchspace transform defines.
 from __future__ import annotations
 
 import math
-from typing import Optional
 
 import numpy as np
 

@@ -15,7 +15,6 @@ schedule with blocks distributed over devices.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

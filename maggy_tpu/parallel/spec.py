@@ -12,7 +12,7 @@ reference (§2.9).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 # Canonical mesh-axis names, in layout-priority order. ICI-heavy axes (tensor, seq)
 # should map to the innermost/physically-closest devices; `stage` (pipeline:

@@ -81,6 +81,7 @@ class Trainer:
     def __post_init__(self):
         self._train_step = None
         self._eval_step = None
+        self._eval_loss_step = None
         self.state_shardings = None
 
     # ------------------------------------------------------------------ state
@@ -158,6 +159,24 @@ class Trainer:
             self._eval_step = jax.jit(eval_step)
         with self.mesh:
             return self._eval_step(state, batch)
+
+    def evaluate(self, state: TrainState, data_iter, num_batches: int) -> Dict[str, float]:
+        """Mean loss over ``num_batches`` held-out batches (no state update).
+        The loss is computed inside jit so full logits never leave the device."""
+        if num_batches < 1:
+            raise ValueError("evaluate needs num_batches >= 1")
+        if self._eval_loss_step is None:
+            def eval_loss(state, batch):
+                logits = state.apply_fn({"params": state.params}, *_model_inputs(batch))
+                return self.loss_fn(logits, batch)
+
+            self._eval_loss_step = jax.jit(eval_loss)
+        losses = []
+        with self.mesh:
+            for _ in range(num_batches):
+                batch = self.shard_batch(next(data_iter))
+                losses.append(self._eval_loss_step(state, batch))
+        return {"loss": float(sum(float(l) for l in losses) / num_batches)}
 
     def fit(
         self,
