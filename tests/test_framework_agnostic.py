@@ -1,0 +1,55 @@
+"""The oblivious train_fn is framework-agnostic: a torch (CPU) training
+function runs under lagom HPO unchanged — the migration path for reference
+users whose train_fns are torch/keras code."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from maggy_tpu import Searchspace, experiment
+from maggy_tpu.config import HyperparameterOptConfig
+
+
+def test_torch_train_fn_under_lagom(tmp_env):
+    rng = np.random.default_rng(0)
+    X = torch.tensor(rng.normal(size=(256, 8)).astype(np.float32))
+    w = torch.tensor(rng.normal(size=(8, 1)).astype(np.float32))
+    y = (X @ w > 0).float()
+
+    def train(hparams, reporter):
+        torch.manual_seed(0)
+        model = torch.nn.Sequential(
+            torch.nn.Linear(8, hparams["width"]),
+            torch.nn.ReLU(),
+            torch.nn.Linear(hparams["width"], 1),
+        )
+        opt = torch.optim.Adam(model.parameters(), lr=hparams["lr"])
+        loss_fn = torch.nn.BCEWithLogitsLoss()
+        for step in range(60):
+            opt.zero_grad()
+            loss = loss_fn(model(X), y)
+            loss.backward()
+            opt.step()
+            if step % 20 == 19:
+                reporter.broadcast(-float(loss.item()), step=step)
+        with torch.no_grad():
+            acc = float(((model(X) > 0).float() == y).float().mean())
+        return {"metric": acc}
+
+    cfg = HyperparameterOptConfig(
+        num_trials=4,
+        optimizer="randomsearch",
+        searchspace=Searchspace(
+            lr=("DOUBLE", [1e-3, 1e-1]), width=("DISCRETE", [8, 16, 32])
+        ),
+        direction="max",
+        num_executors=2,
+        es_policy="none",
+        hb_interval=0.05,
+        seed=3,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] == 4
+    assert result["best"]["metric"] > 0.9
+    assert result["errors"] == 0
