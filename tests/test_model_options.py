@@ -1,0 +1,66 @@
+"""Decoder config options not covered elsewhere: tied embeddings, logit
+softcap, unrolled layers; GCS env gating."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maggy_tpu.models import Decoder, DecoderConfig
+
+
+def test_tied_embeddings_reduce_params():
+    tokens = jnp.zeros((1, 8), dtype=jnp.int32)
+    tied = Decoder(DecoderConfig.tiny(tie_embeddings=True))
+    untied = Decoder(DecoderConfig.tiny(tie_embeddings=False))
+    v_tied = tied.init(jax.random.key(0), tokens)
+    v_untied = untied.init(jax.random.key(0), tokens)
+    n = lambda v: sum(x.size for x in jax.tree.leaves(v))  # noqa: E731
+    assert n(v_tied) < n(v_untied)
+    assert "lm_head" not in v_tied["params"]
+    out = tied.apply(v_tied, tokens)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_logits_softcap_bounds_logits():
+    cfg = DecoderConfig.tiny(logits_softcap=5.0)
+    model = Decoder(cfg)
+    tokens = jnp.asarray(np.arange(16)[None, :], dtype=jnp.int32)
+    variables = model.init(jax.random.key(0), tokens)
+    logits = model.apply(variables, tokens)
+    assert float(jnp.abs(logits).max()) <= 5.0 + 1e-5
+
+
+def test_gcs_env_gated_without_fsspec(monkeypatch):
+    import builtins
+
+    from maggy_tpu.core.env.gcs import GcsEnv
+
+    real_import = builtins.__import__
+
+    def no_fsspec(name, *args, **kwargs):
+        if name == "fsspec":
+            raise ImportError("fsspec unavailable")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_fsspec)
+    env = GcsEnv("gs://bucket")
+    with pytest.raises(RuntimeError, match="fsspec"):
+        env.exists("gs://bucket/x")
+
+
+def test_env_selection(monkeypatch, tmp_path):
+    from maggy_tpu.core import env as env_mod
+
+    env_mod.set_instance(None)
+    monkeypatch.setenv("MAGGY_TPU_LOG_ROOT", "gs://bucket/experiments")
+    from maggy_tpu.core.env.gcs import GcsEnv
+
+    assert isinstance(env_mod.get_instance(), GcsEnv)
+    env_mod.set_instance(None)
+    monkeypatch.setenv("MAGGY_TPU_LOG_ROOT", str(tmp_path))
+    from maggy_tpu.core.env.base import BaseEnv
+
+    inst = env_mod.get_instance()
+    assert isinstance(inst, BaseEnv) and not isinstance(inst, GcsEnv)
+    env_mod.set_instance(None)
