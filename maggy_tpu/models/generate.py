@@ -1,0 +1,74 @@
+"""Autoregressive generation for the decoder families.
+
+Static-shape, jit-friendly sampling: the token buffer is padded to
+``max_len`` and a ``lax.fori_loop`` fills one position per step, so XLA
+compiles a single program regardless of prompt/output lengths. Each step
+recomputes the full prefix (no KV cache yet — O(L·S²) compute, fine for
+evaluation-sized models; a cache-backed decode path is the planned
+optimization). Greedy (``temperature=0``) or temperature sampling with
+optional top-k.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "temperature", "top_k", "eos_id"),
+)
+def generate(
+    model,
+    variables,
+    prompt: jax.Array,
+    prompt_len: jax.Array,
+    *,
+    rng: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    eos_id: int = -1,
+) -> jax.Array:
+    """Fill the buffer after each row's prompt with sampled continuations.
+
+    :param prompt: int32 [B, max_len] buffer — prompt tokens left-aligned,
+        tail arbitrary (overwritten).
+    :param prompt_len: int32 [B] true prompt lengths (>= 1).
+    :returns: int32 [B, max_len]; after a row hits ``eos_id`` it repeats it.
+    """
+    max_len = prompt.shape[1]
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def step(p, carry):
+        tokens, rng, done = carry
+        logits = model.apply(variables, tokens)  # [B, max_len, V]
+        last = jax.lax.dynamic_index_in_dim(logits, p, axis=1, keepdims=False)
+        if temperature <= 0.0:
+            nxt = jnp.argmax(last, axis=-1)
+        else:
+            scaled = last / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+                scaled = jnp.where(scaled < kth, -1e30, scaled)
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
+        nxt = nxt.astype(tokens.dtype)
+        # position p+1 gets a generated token only once the prompt is consumed
+        generating = (p + 1) >= prompt_len  # [B]
+        if eos_id >= 0:
+            nxt = jnp.where(done, jnp.asarray(eos_id, tokens.dtype), nxt)
+            # discarded mid-prompt predictions must not latch the done flag
+            done = done | (generating & (nxt == eos_id))
+        current = jax.lax.dynamic_index_in_dim(tokens, p + 1, axis=1, keepdims=False)
+        new_col = jnp.where(generating, nxt, current)
+        tokens = jax.lax.dynamic_update_index_in_dim(tokens, new_col, p + 1, axis=1)
+        return tokens, rng, done
+
+    done0 = jnp.zeros((prompt.shape[0],), dtype=bool)
+    tokens, _, _ = jax.lax.fori_loop(0, max_len - 1, step, (prompt, rng, done0))
+    return tokens
