@@ -11,6 +11,10 @@ import sys
 
 sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
 
+from maggy_tpu.util import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
 import dataclasses
 
 import jax

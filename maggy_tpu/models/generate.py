@@ -38,6 +38,9 @@ def generate(
     :param prompt: int32 [B, max_len] buffer — prompt tokens left-aligned,
         tail arbitrary (overwritten).
     :param prompt_len: int32 [B] true prompt lengths (>= 1).
+    :param rng: PRNG key for temperature sampling. Defaults to a FIXED
+        ``jax.random.key(0)`` — repeated calls return identical samples; pass
+        a fresh key per call for diverse samples.
     :returns: int32 [B, max_len]; after a row hits ``eos_id`` it repeats it.
     """
     max_len = prompt.shape[1]

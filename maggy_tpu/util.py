@@ -20,6 +20,16 @@ import numpy as np
 from maggy_tpu import constants, exceptions
 
 
+def pin_cpu_if_requested() -> None:
+    """Honor ``JAX_PLATFORMS=cpu`` even on images whose accelerator plugin
+    overrides the env var. Must run before any JAX backend use; examples and
+    bench call it right after import."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def inject_kwargs(fn: Callable, available: Dict[str, Any]) -> Dict[str, Any]:
     """Inspect ``fn``'s signature and return only the kwargs it asks for.
 

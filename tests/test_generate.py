@@ -66,14 +66,16 @@ def test_eos_propagates(trained):
     model, variables = trained
     prompt = np.zeros((2, 10), dtype=np.int32)
     prompt[:, :3] = [[0, 2, 4], [1, 3, 5]]
-    out = generate(
-        model, variables, jnp.asarray(prompt), jnp.asarray([3, 3]), eos_id=6
+    plen = jnp.asarray([3, 3])
+    # choose the eos id the model actually generates first, so EOS must fire
+    free_run = np.asarray(generate(model, variables, jnp.asarray(prompt), plen))
+    eos = int(free_run[0, 3])
+    out = np.asarray(
+        generate(model, variables, jnp.asarray(prompt), plen, eos_id=eos)
     )
-    out = np.asarray(out)
-    for row in out:
-        hits = np.where(row == 6)[0]
-        if hits.size:
-            assert (row[hits[0]:] == 6).all()  # everything after EOS stays EOS
+    hits = np.where(out[0] == eos)[0]
+    assert hits.size, (out, eos)
+    assert (out[0, hits[0]:] == eos).all()  # everything after EOS stays EOS
 
 
 def test_variable_prompt_lengths(trained):
