@@ -46,6 +46,9 @@ class DecoderConfig:
     logits_softcap: float = 0.0
     tie_embeddings: bool = False
     attention_fn: Optional[Callable] = None
+    # decode=True switches attention to the KV-cache incremental path
+    # (build via `dataclasses.replace(cfg, decode=True)`; params are identical)
+    decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -176,8 +179,11 @@ class Attention(nn.Module):
         v = _dense((cfg.n_kv_heads, hd), ("embed", "kv", None), cfg, "wv")(x)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        attn = cfg.attention_fn or default_attention
-        out = attn(q, k, v, causal=True)
+        if cfg.decode:
+            out = self._cached_attention(q, k, v, positions)
+        else:
+            attn = cfg.attention_fn or default_attention
+            out = attn(q, k, v, causal=True)
         out = nn.DenseGeneral(
             features=cfg.d_model,
             axis=(-2, -1),
@@ -190,6 +196,52 @@ class Attention(nn.Module):
             name="wo",
         )(out)
         return out
+
+    def _cached_attention(self, q, k, v, positions):
+        """Incremental decoding: append this chunk's K/V to a cache of
+        ``max_seq_len`` and attend the chunk's queries over everything cached
+        so far (the KV-cache path the recompute-based generate() lacks)."""
+        cfg = self.cfg
+        b, t, kh, hd = k.shape
+        k_cache = self.variable(
+            "cache", "k",
+            lambda: jnp.zeros((b, cfg.max_seq_len, kh, hd), cfg.dtype),
+        )
+        v_cache = self.variable(
+            "cache", "v",
+            lambda: jnp.zeros((b, cfg.max_seq_len, kh, hd), cfg.dtype),
+        )
+        index = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = index.value
+        k_all = jax.lax.dynamic_update_slice(
+            k_cache.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            v_cache.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+        )
+        k_cache.value = k_all
+        v_cache.value = v_all
+        index.value = idx + t
+
+        key_pos = jnp.arange(cfg.max_seq_len)
+        # causal over the cache: a query at position p sees keys at <= p that
+        # have actually been written (key_pos < idx + t)
+        mask = (key_pos[None, None, None, :] <= positions[:, None, :, None]) & (
+            key_pos < idx + t
+        )[None, None, None, :]
+        h = q.shape[2]
+        group = h // kh
+        qg = q.reshape(b, t, kh, group, hd)
+        s = jnp.einsum(
+            "bqkgd,bskd->bkgqs", qg, k_all, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(hd).astype(jnp.float32)
+        # mask [b, 1, t, S] -> broadcast over (kh, group) to [b, kh, group, t, S]
+        s = jnp.where(mask[:, :, None, :, :], s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(v_all.dtype)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_all)
+        return out.reshape(b, t, h, hd)
 
 
 class MLPBlock(nn.Module):
@@ -248,7 +300,7 @@ class Decoder(nn.Module):
         x = jnp.asarray(embed, cfg.dtype)[tokens]
 
         layer_cls = _ScannedLayer
-        if cfg.remat:
+        if cfg.remat and not cfg.decode:  # no gradients (hence no remat) in decode
             layer_cls = nn.remat(
                 layer_cls,
                 prevent_cse=not cfg.scan_layers,
@@ -257,7 +309,7 @@ class Decoder(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 layer_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,  # positions are the same for every layer
                 length=cfg.n_layers,
