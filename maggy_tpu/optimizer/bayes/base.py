@@ -28,16 +28,26 @@ class BaseAsyncBO(AbstractOptimizer):
         num_warmup_trials: int = 15,
         random_fraction: float = 0.33,
         imputation: str = "cl_min",
+        multi_fidelity: str = "per_rung",
         **kwargs,
     ):
+        """``multi_fidelity`` (only relevant with a pruner): "per_rung" trains
+        one surrogate per budget rung; "augment" trains a single surrogate over
+        budget-augmented final metrics z=[x, b/b_max] using ALL observations —
+        one row per finalized trial (the reference's augmentation additionally
+        emits per-epoch interim rows, bayes/base.py:459-641; that refinement is
+        in TODO.md)."""
         super().__init__(**kwargs)
         if not 0 <= random_fraction <= 1:
             raise ValueError("random_fraction must be in [0, 1]")
         if imputation not in ("cl_min", "cl_max", "cl_mean"):
             raise ValueError("imputation must be one of cl_min/cl_max/cl_mean")
+        if multi_fidelity not in ("per_rung", "augment"):
+            raise ValueError("multi_fidelity must be per_rung or augment")
         self.num_warmup_trials = int(num_warmup_trials)
         self.random_fraction = float(random_fraction)
         self.imputation = imputation
+        self.multi_fidelity = multi_fidelity
 
     def initialize(self) -> None:
         warmup = min(self.num_warmup_trials, self.num_trials)
@@ -53,8 +63,12 @@ class BaseAsyncBO(AbstractOptimizer):
         """Fit and return a surrogate for (X, y) in the unit cube (y minimized)."""
 
     @abstractmethod
-    def sample_from_model(self, model) -> np.ndarray:
-        """Propose the next point in the unit cube from a fitted surrogate."""
+    def sample_from_model(self, model, fixed_last: Optional[float] = None) -> np.ndarray:
+        """Propose the next point in the unit cube from a fitted surrogate.
+
+        ``fixed_last``: multi-fidelity augmentation — the model's last input
+        dimension is a normalized budget pinned to this value; the returned
+        vector EXCLUDES that coordinate."""
 
     def get_suggestion(self, trial: Optional[Trial] = None) -> Union[Trial, str, None]:
         if self.pruner is not None:
@@ -120,11 +134,7 @@ class BaseAsyncBO(AbstractOptimizer):
             X_parts.append(X_done)
             y_parts.append(y_done)
         if y_done.size and self.trial_store:
-            liar = {
-                "cl_min": float(y_done.min()),
-                "cl_max": float(y_done.max()),
-                "cl_mean": float(y_done.mean()),
-            }[self.imputation]
+            liar = self._liar(y_done)
             busy = self.searchspace.transform_many(
                 [
                     self._strip_budget(t.params)
@@ -159,20 +169,76 @@ class BaseAsyncBO(AbstractOptimizer):
                 return b
         return target_budget
 
+    def _liar(self, y_done: np.ndarray) -> float:
+        """Constant-liar value for busy-trial imputation."""
+        return {
+            "cl_min": float(y_done.min()),
+            "cl_max": float(y_done.max()),
+            "cl_mean": float(y_done.mean()),
+        }[self.imputation]
+
+    def _augmented_training_set(self, target_budget: Optional[float]):
+        """[x, b/b_max] design over ALL observations + busy imputation; returns
+        (X_aug, y, b_norm) with b_norm the normalized target coordinate."""
+        max_b = self.get_max_budget() or target_budget or 1.0
+        obs = self._observed()
+        if not obs:
+            return None, None, 1.0
+        X = self.searchspace.transform_many([self._strip_budget(t.params) for t in obs])
+        b = np.asarray(
+            [t.params.get("budget", max_b) / max_b for t in obs], dtype=np.float64
+        )
+        # y derived from the SAME `obs` list so X/y rows always align
+        y = np.asarray(
+            [
+                -t.final_metric if self.direction == "max" else t.final_metric
+                for t in obs
+            ],
+            dtype=np.float64,
+        )
+        X_aug = np.concatenate([X, b[:, None]], axis=1)
+        if self.trial_store:
+            liar = self._liar(y)
+            busy = list(self.trial_store.values())
+            Xb = self.searchspace.transform_many(
+                [self._strip_budget(t.params) for t in busy]
+            )
+            bb = np.asarray(
+                [t.params.get("budget", max_b) / max_b for t in busy], dtype=np.float64
+            )
+            X_aug = np.concatenate(
+                [X_aug, np.concatenate([Xb, bb[:, None]], axis=1)]
+            )
+            y = np.concatenate([y, np.full(len(busy), liar)])
+        b_norm = (target_budget / max_b) if target_budget else 1.0
+        return X_aug, y, float(min(b_norm, 1.0))
+
     def _model_proposal(
         self, dedup_attempts: int = 3, budget: Optional[float] = None
     ) -> Optional[dict]:
-        model_budget = self._model_budget(budget)
-        X, y = self._training_set(model_budget)
-        if X is None or len(X) < max(3, len(self.searchspace) + 1):
+        fixed_coord = None
+        if self.multi_fidelity == "augment" and budget is not None:
+            X, y, b_norm = self._augmented_training_set(budget)
+            fixed_coord = b_norm
+            model_key = "augment"
+        else:
+            model_budget = self._model_budget(budget)
+            X, y = self._training_set(model_budget)
+            model_key = model_budget
+        # augment mode has one extra (budget) column — require one more row
+        min_rows = max(3, (X.shape[1] if X is not None else 0) + 1,
+                       len(self.searchspace) + 1)
+        if X is None or len(X) < min_rows:
             return None
         try:
             model = self.fit_model(X, y)
         except Exception:  # singular kernels etc. — fall back to random
             return None
-        self.models[model_budget] = model
+        self.models[model_key] = model
         for _ in range(dedup_attempts):
-            vec = np.clip(self.sample_from_model(model), 0.0, 1.0)
+            vec = np.clip(
+                self.sample_from_model(model, fixed_last=fixed_coord), 0.0, 1.0
+            )
             params = self.searchspace.inverse_transform(vec)
             if not self.hparams_exist(params):
                 return params

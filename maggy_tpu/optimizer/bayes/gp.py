@@ -149,22 +149,32 @@ class GP(BaseAsyncBO):
             return -ei
         return -_norm_cdf(z)  # pi
 
-    def sample_from_model(self, model: _FittedGP) -> np.ndarray:
+    def sample_from_model(self, model: _FittedGP, fixed_last=None) -> np.ndarray:
         d = model.X.shape[1]
-        Xs = self.rng.random((self.acq_samples, d))
-        acq = self._acquisition(model, Xs)
+        d_free = d - 1 if fixed_last is not None else d
+
+        def embed(x_free):
+            if fixed_last is None:
+                return x_free
+            pad = np.full((*x_free.shape[:-1], 1), fixed_last)
+            return np.concatenate([x_free, pad], axis=-1)
+
+        Xs = self.rng.random((self.acq_samples, d_free))
+        acq = self._acquisition(model, embed(Xs))
         x0 = Xs[int(np.argmin(acq))]
-        # local refinement of the incumbent candidate
+        # local refinement of the incumbent candidate (free dims only)
         try:
             from scipy.optimize import minimize
 
             res = minimize(
-                lambda x: float(self._acquisition(model, x[None, :])[0]),
+                lambda x: float(self._acquisition(model, embed(x)[None, :])[0]),
                 x0,
                 method="L-BFGS-B",
-                bounds=[(0.0, 1.0)] * d,
+                bounds=[(0.0, 1.0)] * d_free,
             )
-            if res.success and res.fun <= float(self._acquisition(model, x0[None, :])[0]):
+            if res.success and res.fun <= float(
+                self._acquisition(model, embed(x0)[None, :])[0]
+            ):
                 return np.asarray(res.x)
         except ImportError:  # pragma: no cover
             pass

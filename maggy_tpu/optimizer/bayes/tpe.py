@@ -95,8 +95,11 @@ class TPE(BaseAsyncBO):
         good, bad = self._split(X, y)
         return (_KDE(good, _scott_bw(good)), _KDE(bad, _scott_bw(bad)))
 
-    def sample_from_model(self, model) -> np.ndarray:
+    def sample_from_model(self, model, fixed_last=None) -> np.ndarray:
         kde_good, kde_bad = model
         cand = kde_good.sample(self.rng, self.num_samples, self.bw_factor)
+        if fixed_last is not None:
+            cand[:, -1] = fixed_last  # pin the normalized budget coordinate
         ei = kde_good.pdf(cand) / kde_bad.pdf(cand)
-        return cand[int(np.argmax(ei))]
+        best = cand[int(np.argmax(ei))]
+        return best[:-1] if fixed_last is not None else best

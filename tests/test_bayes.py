@@ -107,6 +107,58 @@ def test_busy_imputation_training_set():
     np.testing.assert_allclose(y[-2:], y[:4].mean())
 
 
+@pytest.mark.parametrize("name", ["gp", "tpe"])
+def test_multi_fidelity_augment_with_hyperband(name, tmp_env):
+    """Single [x, budget]-augmented surrogate drives a hyperband run e2e."""
+    from maggy_tpu import experiment
+    from maggy_tpu.config import HyperparameterOptConfig
+
+    def train(hparams, budget, reporter):
+        for step in range(int(budget)):
+            reporter.broadcast(-((hparams["x"] - 0.7) ** 2), step=step)
+        return -((hparams["x"] - 0.7) ** 2) - 0.01 / budget
+
+    cfg = HyperparameterOptConfig(
+        num_trials=1,
+        optimizer=get_optimizer(
+            name, seed=0, num_warmup_trials=4, multi_fidelity="augment",
+            random_fraction=0.1,
+        ),
+        searchspace=Searchspace(x=("DOUBLE", [0.0, 1.0]), y=("DOUBLE", [0.0, 1.0])),
+        direction="max",
+        num_executors=4,
+        es_policy="none",
+        hb_interval=0.05,
+        pruner="hyperband",
+        pruner_config={"eta": 3, "resource_min": 1, "resource_max": 9},
+        seed=0,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] == 22  # 9+3+1 + 5+1 + 3
+    assert result["errors"] == 0
+    assert result["best"]["metric"] > -0.05  # converged near x=0.7
+
+
+def test_augment_training_set_shapes():
+    gp = GP(seed=0, multi_fidelity="augment")
+    gp.setup(space(), 20, {}, [], direction="max")
+    for i, b in enumerate([1, 1, 3, 9]):
+        t = gp.create_trial({"x": 0.2 * i, "y": 0.5}, budget=b)
+        t.finalize(float(i))
+        gp.final_store.append(t)
+    busy = gp.create_trial({"x": 0.9, "y": 0.9}, budget=3)
+    gp.trial_store[busy.trial_id] = busy
+    X, y, b_norm = gp._augmented_training_set(target_budget=9)
+    assert X.shape == (5, 3)  # 2 hparams + budget column, 4 observed + 1 busy
+    assert y.shape == (5,)
+    np.testing.assert_allclose(X[:4, -1], [1 / 9, 1 / 9, 3 / 9, 1.0])
+    assert b_norm == 1.0
+    # proposal excludes the budget coordinate
+    params = gp._model_proposal(budget=9)
+    if params is not None:
+        assert set(params) == {"x", "y"}
+
+
 def test_validation_errors():
     with pytest.raises(ValueError):
         GP(acq_fun="ucb")
@@ -116,3 +168,5 @@ def test_validation_errors():
         GP(random_fraction=2.0)
     with pytest.raises(ValueError):
         GP(imputation="median")
+    with pytest.raises(ValueError):
+        GP(multi_fidelity="interp")
