@@ -29,14 +29,15 @@ class BaseAsyncBO(AbstractOptimizer):
         random_fraction: float = 0.33,
         imputation: str = "cl_min",
         multi_fidelity: str = "per_rung",
+        interim_rows: int = 0,
         **kwargs,
     ):
         """``multi_fidelity`` (only relevant with a pruner): "per_rung" trains
         one surrogate per budget rung; "augment" trains a single surrogate over
-        budget-augmented final metrics z=[x, b/b_max] using ALL observations —
-        one row per finalized trial (the reference's augmentation additionally
-        emits per-epoch interim rows, bayes/base.py:459-641; that refinement is
-        in TODO.md)."""
+        budget-augmented final metrics z=[x, b/b_max] using ALL observations.
+        ``interim_rows > 0`` additionally emits up to that many rows per trial
+        from its heartbeat metric history at fractional budgets — the
+        reference's interim-results augmentation (bayes/base.py:459-641)."""
         super().__init__(**kwargs)
         if not 0 <= random_fraction <= 1:
             raise ValueError("random_fraction must be in [0, 1]")
@@ -48,6 +49,7 @@ class BaseAsyncBO(AbstractOptimizer):
         self.random_fraction = float(random_fraction)
         self.imputation = imputation
         self.multi_fidelity = multi_fidelity
+        self.interim_rows = int(interim_rows)
 
     def initialize(self) -> None:
         warmup = min(self.num_warmup_trials, self.num_trials)
@@ -197,8 +199,33 @@ class BaseAsyncBO(AbstractOptimizer):
             dtype=np.float64,
         )
         X_aug = np.concatenate([X, b[:, None]], axis=1)
+        # busy-trial liar comes from FINAL metrics only, before interim rows
+        # dilute y with early-training values
+        liar = self._liar(y) if self.trial_store and y.size else None
+        if self.interim_rows > 0:
+            # interim observations: the metric after the j-th of n heartbeats of
+            # a budget-b trial sits at fractional budget (j+1)/n * b/b_max —
+            # scaled by position in the trial's OWN history, since heartbeat
+            # step numbering is user-defined and not in budget units
+            extra_X, extra_y = [], []
+            for t, x_row, b_frac in zip(obs, X, b):
+                n_hist = len(t.metric_history)
+                if n_hist == 0:
+                    continue
+                idx = (
+                    np.linspace(0, n_hist - 1, self.interim_rows).astype(int)
+                    if n_hist > self.interim_rows
+                    else np.arange(n_hist)
+                )
+                for j in idx:
+                    frac = (j + 1) / n_hist * b_frac
+                    m = t.metric_history[j]
+                    extra_X.append(np.concatenate([x_row, [frac]]))
+                    extra_y.append(-m if self.direction == "max" else m)
+            if extra_X:
+                X_aug = np.concatenate([X_aug, np.stack(extra_X)])
+                y = np.concatenate([y, np.asarray(extra_y, dtype=np.float64)])
         if self.trial_store:
-            liar = self._liar(y)
             busy = list(self.trial_store.values())
             Xb = self.searchspace.transform_many(
                 [self._strip_budget(t.params) for t in busy]

@@ -32,6 +32,11 @@ class _KDE:
         self.X = X
         self.bw = np.maximum(bw, 1e-3)
 
+    def marginal(self, n_dims: int) -> "_KDE":
+        """Marginal over the first ``n_dims`` (per-dim product kernels
+        marginalize by dropping factors)."""
+        return _KDE(self.X[:, :n_dims], self.bw[:n_dims])
+
     def pdf(self, Q: np.ndarray) -> np.ndarray:
         # [q, n, d] standardized distances
         z = (Q[:, None, :] - self.X[None, :, :]) / self.bw
@@ -99,7 +104,11 @@ class TPE(BaseAsyncBO):
         kde_good, kde_bad = model
         cand = kde_good.sample(self.rng, self.num_samples, self.bw_factor)
         if fixed_last is not None:
-            cand[:, -1] = fixed_last  # pin the normalized budget coordinate
+            # score over the free dims only: a pinned budget coordinate far
+            # from the observed budgets would zero both pdfs and flatten EI
+            d_free = cand.shape[1] - 1
+            free = cand[:, :d_free]
+            ei = kde_good.marginal(d_free).pdf(free) / kde_bad.marginal(d_free).pdf(free)
+            return free[int(np.argmax(ei))]
         ei = kde_good.pdf(cand) / kde_bad.pdf(cand)
-        best = cand[int(np.argmax(ei))]
-        return best[:-1] if fixed_last is not None else best
+        return cand[int(np.argmax(ei))]

@@ -159,6 +159,24 @@ def test_augment_training_set_shapes():
         assert set(params) == {"x", "y"}
 
 
+def test_augment_interim_rows():
+    gp = GP(seed=0, multi_fidelity="augment", interim_rows=2)
+    gp.setup(space(), 20, {}, [], direction="max")
+    for i, b in enumerate([4, 4]):
+        t = gp.create_trial({"x": 0.3 * i, "y": 0.4}, budget=b)
+        for s, m in enumerate([0.1, 0.2, 0.3, 0.4]):
+            t.append_metric(m + i, step=s)
+        t.finalize(0.4 + i)
+        gp.final_store.append(t)
+    X, y, _ = gp._augmented_training_set(target_budget=4)
+    # 2 final rows + 2 trials x 2 interim rows
+    assert X.shape == (6, 3) and y.shape == (6,)
+    # interim budget fractions in (0, 1]; first subsampled point is step 0 -> 1/4
+    assert set(np.round(X[2:, -1], 3)) == {0.25, 1.0}
+    # direction=max negates interim metrics too
+    assert y[2] == -0.1
+
+
 def test_validation_errors():
     with pytest.raises(ValueError):
         GP(acq_fun="ucb")
