@@ -19,7 +19,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -28,28 +27,21 @@ def ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     """The TPU tunnel can wedge so that jax.devices() hangs forever; probe it
     in a subprocess first and fall back to CPU so the bench always completes
     and reports what it ran on. Returns True when the fallback engaged."""
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout,
-            check=True,
-            capture_output=True,
-        )
-        return False
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip()
-        import jax
+    from maggy_tpu.util import backend_alive, force_cpu
 
-        jax.config.update("jax_platforms", "cpu")
-        print(
-            "WARNING: accelerator backend unreachable; benchmarking on a CPU "
-            "fallback mesh with a reduced geometry",
-            file=sys.stderr,
-        )
-        return True
+    if backend_alive(probe_timeout):
+        return False
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    force_cpu()
+    print(
+        "WARNING: accelerator backend unreachable; benchmarking on a CPU "
+        "fallback mesh with a reduced geometry",
+        file=sys.stderr,
+    )
+    return True
 
 
 def count_params(tree) -> int:

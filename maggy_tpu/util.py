@@ -20,14 +20,50 @@ import numpy as np
 from maggy_tpu import constants, exceptions
 
 
+def force_cpu() -> None:
+    """Pin JAX to the CPU backend (env var + config, belt and braces against
+    plugins that re-assert their platform). Must run before any backend use."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # very old jax without the option — env var still set
+        pass
+
+
 def pin_cpu_if_requested() -> None:
     """Honor ``JAX_PLATFORMS=cpu`` even on images whose accelerator plugin
     overrides the env var. Must run before any JAX backend use; examples and
     bench call it right after import."""
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        import jax
+        force_cpu()
 
-        jax.config.update("jax_platforms", "cpu")
+
+def backend_alive(probe_timeout: float = 120.0) -> bool:
+    """Probe whether JAX backend init completes, in a subprocess so a wedged
+    accelerator transport cannot hang the caller. Bounded even against a child
+    stuck in uninterruptible I/O (kill + short bounded wait, then give up).
+    Returns True without probing when CPU is already requested."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return True
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        return proc.wait(timeout=probe_timeout) == 0
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass  # D-state child; abandon it rather than block
+        return False
 
 
 def inject_kwargs(fn: Callable, available: Dict[str, Any]) -> Dict[str, Any]:
