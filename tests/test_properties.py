@@ -1,0 +1,140 @@
+"""Property-based tests (hypothesis): searchspace transform bijectivity over
+arbitrary spaces, trial JSON round-trips, RPC framing, ShardingSpec algebra."""
+
+import json
+import string
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from maggy_tpu import Searchspace, Trial
+from maggy_tpu.parallel.spec import ShardingSpec
+
+NAMES = st.text(alphabet=string.ascii_lowercase, min_size=1, max_size=8).filter(
+    lambda s: not hasattr(Searchspace, s)
+)
+
+
+@st.composite
+def searchspaces(draw):
+    n = draw(st.integers(1, 4))
+    names = draw(
+        st.lists(NAMES, min_size=n, max_size=n, unique=True)
+    )
+    space = Searchspace()
+    for name in names:
+        kind = draw(st.sampled_from(["DOUBLE", "INTEGER", "DISCRETE", "CATEGORICAL"]))
+        if kind == "DOUBLE":
+            lo = draw(st.floats(-1e6, 1e6, allow_nan=False))
+            hi = draw(st.floats(lo + 1e-6, lo + 1e7, allow_nan=False))
+            space.add(name, (kind, [lo, hi]))
+        elif kind == "INTEGER":
+            lo = draw(st.integers(-10_000, 10_000))
+            hi = draw(st.integers(lo + 1, lo + 20_000))
+            space.add(name, (kind, [lo, hi]))
+        elif kind == "DISCRETE":
+            vals = draw(
+                st.lists(st.integers(-1000, 1000), min_size=1, max_size=6, unique=True)
+            )
+            space.add(name, (kind, vals))
+        else:
+            vals = draw(
+                st.lists(
+                    st.text(string.ascii_letters, min_size=1, max_size=5),
+                    min_size=1,
+                    max_size=6,
+                    unique=True,
+                )
+            )
+            space.add(name, (kind, vals))
+    return space
+
+
+@settings(max_examples=60, deadline=None, derandomize=True)
+@given(searchspaces(), st.integers(0, 2**31 - 1))
+def test_transform_roundtrip_property(space, seed):
+    params = space.get_random_parameter_values(1, seed=seed)[0]
+    vec = space.transform(params)
+    assert ((vec >= 0) & (vec <= 1)).all()
+    back = space.inverse_transform(vec)
+    for item in space.items():
+        name, kind = item["name"], item["type"]
+        if kind == "DOUBLE":
+            scale = max(abs(v) for v in item["values"]) or 1.0
+            assert abs(back[name] - params[name]) <= 1e-9 * scale + 1e-12
+        else:
+            assert back[name] == params[name]
+
+
+@settings(max_examples=60, deadline=None, derandomize=True)
+@given(searchspaces(), st.lists(st.floats(0, 1), min_size=4, max_size=4))
+def test_any_cube_point_decodes_valid(space, coords):
+    vec = np.asarray(coords[: len(space)])
+    params = space.inverse_transform(vec)
+    assert space.contains(params)
+
+
+@settings(max_examples=60, deadline=None, derandomize=True)
+@given(searchspaces(), st.integers(0, 2**31 - 1))
+def test_trial_json_roundtrip_property(space, seed):
+    params = space.get_random_parameter_values(1, seed=seed)[0]
+    t = Trial(params)
+    for s in range(seed % 4):
+        t.append_metric(float(s) * 0.1, step=s)
+    if seed % 2:
+        t.finalize(1.5)
+    t2 = Trial.from_json(t.to_json())
+    assert t2.trial_id == t.trial_id
+    assert t2.status == t.status
+    assert t2.metric_history == t.metric_history
+    # canonical id is stable under key reordering
+    assert Trial.compute_id(dict(reversed(list(params.items())))) == t.trial_id
+
+
+@settings(max_examples=100, deadline=None, derandomize=True)
+@given(
+    st.integers(1, 8), st.integers(1, 8), st.integers(1, 8),
+    st.integers(1, 4), st.integers(1, 4), st.integers(1, 4),
+)
+def test_sharding_spec_algebra(dp, fsdp, tp, sp, ep, pp):
+    spec = ShardingSpec(dp=dp, fsdp=fsdp, tp=tp, sp=sp, ep=ep, pp=pp)
+    assert spec.num_devices == dp * fsdp * tp * sp * ep * pp
+    sizes = spec.axis_sizes()
+    assert np.prod(sizes) == spec.num_devices
+    # scaled_to is identity when already matching, and always exact when divisible
+    assert spec.scaled_to(spec.num_devices) == spec
+    bigger = spec.num_devices * 3
+    scaled = spec.scaled_to(bigger)
+    assert scaled.num_devices == bigger
+
+
+@settings(max_examples=60, deadline=None, derandomize=True)
+@given(
+    st.dictionaries(
+        st.text(string.ascii_lowercase, min_size=1, max_size=6),
+        st.one_of(
+            st.integers(-(2**31), 2**31),
+            st.floats(allow_nan=False, allow_infinity=False),
+            st.text(max_size=20),
+            st.booleans(),
+            st.none(),
+        ),
+        max_size=6,
+    )
+)
+def test_rpc_frame_roundtrip_property(payload):
+    """Framed JSON messages survive a socketpair round-trip byte-exactly."""
+    import socket
+
+    from maggy_tpu.core import rpc
+
+    a, b = socket.socketpair()
+    try:
+        msg = {"type": "ECHO", **{f"k_{k}": v for k, v in payload.items()}}
+        rpc.send_frame(a, msg)
+        out = rpc.recv_frame(b)
+        assert out == json.loads(json.dumps(msg))
+    finally:
+        a.close()
+        b.close()
