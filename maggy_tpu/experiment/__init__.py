@@ -42,6 +42,13 @@ def lagom(train_fn: Callable, config: LagomConfig) -> Any:
         outputs for single runs, per-worker results for distributed training.
     """
     global APP_ID, RUN_ID, _running
+    if isinstance(train_fn, LagomConfig) and callable(config):
+        raise TypeError(
+            "lagom(train_fn, config): arguments look swapped — got a config "
+            "first and a callable second."
+        )
+    if not callable(train_fn):
+        raise TypeError(f"train_fn must be callable, got {type(train_fn).__name__}")
     with _running_lock:
         if _running:
             raise RuntimeError(

@@ -76,6 +76,32 @@ def inject_kwargs(fn: Callable, available: Dict[str, Any]) -> Dict[str, Any]:
     """
     sig = inspect.signature(fn)
     params = sig.parameters
+    fn_name = getattr(fn, "__name__", "train_fn")
+    # positional-only params can never be injected (we always call with
+    # keywords), whether or not the name matches something available
+    pos_only = [
+        n for n, p in params.items() if p.kind == inspect.Parameter.POSITIONAL_ONLY
+    ]
+    if pos_only:
+        raise exceptions.BadArgumentsError(
+            fn_name,
+            f"has positional-only parameter(s) {pos_only}; the framework "
+            "injects arguments by keyword — drop the '/' marker.",
+        )
+    missing = [
+        name
+        for name, p in params.items()
+        if p.default is inspect.Parameter.empty
+        and p.kind
+        in (inspect.Parameter.POSITIONAL_OR_KEYWORD, inspect.Parameter.KEYWORD_ONLY)
+        and name not in available
+    ]
+    if missing:
+        raise exceptions.BadArgumentsError(
+            fn_name,
+            f"asks for parameter(s) {missing} the framework does not inject "
+            f"here; available: {sorted(available)}.",
+        )
     if any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()):
         return dict(available)
     return {k: v for k, v in available.items() if k in params}

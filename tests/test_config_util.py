@@ -71,6 +71,44 @@ def test_inject_kwargs():
     assert util.inject_kwargs(fn_c, avail) == avail
 
 
+def test_inject_kwargs_unknown_param_errors():
+    def fn_bad(hparams, my_dataset):
+        return None
+
+    def fn_ok(hparams, my_dataset="default"):
+        return None
+
+    avail = {"hparams": {}, "reporter": "R"}
+    with pytest.raises(exceptions.BadArgumentsError, match="my_dataset"):
+        util.inject_kwargs(fn_bad, avail)
+    # defaults are fine — the framework just doesn't fill them
+    assert util.inject_kwargs(fn_ok, avail) == {"hparams": {}}
+
+    # **kwargs does not bypass the required-param check
+    def fn_kw(hparams, my_dataset, **kw):
+        return None
+
+    with pytest.raises(exceptions.BadArgumentsError, match="my_dataset"):
+        util.inject_kwargs(fn_kw, avail)
+
+    # positional-only params are uninjectable, even with matching names
+    exec("def fn_pos(hparams, /): return None", globals())
+    with pytest.raises(exceptions.BadArgumentsError, match="positional-only"):
+        util.inject_kwargs(globals()["fn_pos"], avail)
+
+
+def test_lagom_arg_validation(tmp_env):
+    from maggy_tpu import experiment
+
+    cfg = HyperparameterOptConfig(
+        num_trials=1, optimizer="randomsearch", searchspace=sp(), es_policy="none"
+    )
+    with pytest.raises(TypeError, match="swapped"):
+        experiment.lagom(cfg, lambda hparams: 1.0)
+    with pytest.raises(TypeError, match="callable"):
+        experiment.lagom("not-a-function", cfg)
+
+
 def test_handle_return_val(tmp_path):
     d = str(tmp_path / "trial")
     assert util.handle_return_val(0.5, d, "metric") == 0.5
