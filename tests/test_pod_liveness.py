@@ -1,0 +1,58 @@
+"""Pod worker liveness: a registered worker that goes silent past
+worker_timeout must abort the experiment loudly instead of hanging the driver
+forever (the routine TPU-pod preemption case)."""
+
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import experiment
+from maggy_tpu.config import DistributedConfig
+from maggy_tpu.core import rpc
+
+
+def test_silent_pod_worker_aborts(tmp_env):
+    def train(hparams, reporter, ctx):
+        reporter.broadcast(1.0, step=0)
+        return {"metric": 1.0}
+
+    config = DistributedConfig(
+        hparams={},
+        num_executors=2,
+        sharding="dp",
+        data_plane="local",
+        driver_addr="127.0.0.1:1",  # pod mode marker (driver never dials it)
+        worker_timeout=2.0,
+        hb_interval=0.05,
+    )
+    holder = {}
+
+    def run():
+        try:
+            experiment.lagom(train, config)
+        except BaseException as e:  # noqa: BLE001
+            holder["error"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    # wait for the driver, then impersonate remote partition 1: register once,
+    # heartbeat briefly, then go silent (preempted host)
+    deadline = time.time() + 30
+    driver = None
+    while time.time() < deadline:
+        driver = experiment.CURRENT_DRIVER
+        if driver is not None and driver.server is not None and driver.server.port:
+            break
+        time.sleep(0.02)
+    assert driver is not None and driver.pod_mode
+    ghost = rpc.Client(
+        ("127.0.0.1", driver.server.port), 1, driver.server.secret, hb_interval=0.05
+    )
+    ghost.register({"host": "preempted-host"})
+    ghost.stop()  # silence
+
+    t.join(timeout=60)
+    assert not t.is_alive(), "driver hung on the silent worker"
+    assert "error" in holder
+    assert "silent" in str(holder["error"])
