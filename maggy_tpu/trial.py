@@ -53,8 +53,11 @@ class Trial:
 
     @staticmethod
     def compute_id(params: Dict[str, Any]) -> str:
-        """16-char md5 prefix of the canonical params JSON (reference trial.py:110-136)."""
-        canonical = json.dumps(params, sort_keys=True, default=str, separators=(",", ":"))
+        """16-char md5 prefix of the canonical params JSON — bit-identical to
+        the reference's ids for JSON-native params (trial.py:110-136 uses
+        ``json.dumps(params, sort_keys=True)`` with default separators; the
+        reference suite's expected value "3d1cc9fdb1d4d001" passes here)."""
+        canonical = json.dumps(params, sort_keys=True, default=str)
         return hashlib.md5(canonical.encode("utf-8")).hexdigest()[:16]
 
     # ------------------------------------------------------------------ lifecycle

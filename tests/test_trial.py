@@ -15,6 +15,12 @@ def test_deterministic_id():
     assert t3.trial_id != t1.trial_id
 
 
+def test_id_matches_reference_scheme():
+    """Bit-identical to the reference's own unit-test expectation
+    (maggy/tests/test_trial.py:24-48 asserts this exact hash)."""
+    assert Trial({"param1": 5, "param2": "ada"}).trial_id == "3d1cc9fdb1d4d001"
+
+
 def test_state_machine():
     t = Trial({"x": 1})
     assert t.status == Trial.PENDING
