@@ -12,6 +12,7 @@ optimizer/grad state sharded with params under fsdp; ZeRO-3 ≈ full fsdp).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Optional, Union
 
 from maggy_tpu.config.base import LagomConfig
@@ -72,6 +73,10 @@ class DistributedConfig(LagomConfig):
         self.mixed_precision = bool(mixed_precision)
         self.remat = bool(remat)
         self.process_data = process_data
+        if num_executors is None and os.environ.get("MAGGY_TPU_NUM_EXECUTORS"):
+            # a launcher (maggy_tpu.run) exports the process count so the same
+            # script needs no edits to match the launch width
+            num_executors = int(os.environ["MAGGY_TPU_NUM_EXECUTORS"])
         self.num_executors = num_executors
         self.seed = int(seed)
         self.log_dir = log_dir

@@ -149,7 +149,9 @@ class Driver(ABC):
     def init(self) -> None:
         self.server = self._make_server()
         self._register_msg_callbacks()
-        self.server.start()
+        # a launcher (python -m maggy_tpu.run) pre-assigns the port so workers
+        # can be started with MAGGY_TPU_DRIVER before the driver is up
+        self.server.start(port=int(os.environ.get("MAGGY_TPU_BIND_PORT", "0")))
         self._digestion_thread = threading.Thread(
             target=self._digest_loop, name="maggy-digestion", daemon=True
         )

@@ -13,6 +13,7 @@ means exactly one worker spanning all visible devices.
 
 from __future__ import annotations
 
+import os
 import statistics
 import time
 from typing import Any, Callable, Dict, List
@@ -45,7 +46,10 @@ class DistributedTrainingDriver(Driver):
     # ------------------------------------------------------------------ server
 
     def _make_server(self) -> rpc.Server:
-        return rpc.Server(self.num_executors)
+        # a launcher distributes one secret to every pod process via env
+        return rpc.Server(
+            self.num_executors, secret=os.environ.get("MAGGY_TPU_SECRET") or None
+        )
 
     def _register_msg_callbacks(self) -> None:
         s = self.server

@@ -150,6 +150,7 @@ class Server:
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = threading.Event()
+        self._start_error: Optional[BaseException] = None
         self.host = "127.0.0.1"
         self.port = 0
 
@@ -161,7 +162,15 @@ class Server:
         )
         self._thread.start()
         if not self._started.wait(timeout=10):
+            if self._start_error is not None:
+                raise RpcError(
+                    f"RPC server failed to start: {self._start_error}"
+                ) from self._start_error
             raise RpcError("RPC server failed to start within 10s")
+        if self._start_error is not None:  # e.g. EADDRINUSE on a preset port
+            raise RpcError(
+                f"RPC server failed to start: {self._start_error}"
+            ) from self._start_error
         return self.host, self.port
 
     def _run_loop(self, host: str, port: int) -> None:
@@ -169,7 +178,14 @@ class Server:
         asyncio.set_event_loop(self._loop)
 
         async def _main():
-            self._server = await asyncio.start_server(self._handle_client, host, port)
+            try:
+                self._server = await asyncio.start_server(
+                    self._handle_client, host, port
+                )
+            except OSError as e:  # surface EADDRINUSE etc. to start()
+                self._start_error = e
+                self._started.set()
+                return
             sockname = self._server.sockets[0].getsockname()
             self.host = "127.0.0.1" if host in ("0.0.0.0", "") else host
             self.port = sockname[1]

@@ -121,7 +121,17 @@ def _(config: DistributedConfig, app_id: str, run_id: int):
 def _maybe_run_as_pod_worker(train_fn: Callable, config) -> Optional[Any]:
     """Pod mode: non-zero hosts run a worker against the process-0 driver
     instead of their own driver (core/pod.py)."""
+    import os
+
     if not isinstance(config, DistributedConfig):
+        if os.environ.get("MAGGY_TPU_ROLE") == "worker":
+            # an HPO/ablation script under a pod launcher would otherwise run
+            # N whole independent experiments
+            raise RuntimeError(
+                "MAGGY_TPU_ROLE=worker is only meaningful for DistributedConfig "
+                "experiments; HPO/ablation parallelize inside one driver — run "
+                f"this script as a single process (got {type(config).__name__})."
+            )
         return None
     from maggy_tpu.core import pod
 

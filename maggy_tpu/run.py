@@ -1,0 +1,106 @@
+"""Multi-process experiment launcher.
+
+    python -m maggy_tpu.run --workers 3 my_script.py [script args...]
+
+Starts ``my_script.py`` once as the driver (process 0) and ``workers - 1``
+times as pod workers, wiring MAGGY_TPU_ROLE / DRIVER / SECRET / PARTITION /
+BIND_PORT so the script's ``lagom(train_fn, DistributedConfig(...))`` call
+forms one experiment across the processes (core/pod.py execution model). On a
+real pod, run the equivalent: start the same script on every host with these
+variables pointing at host 0.
+
+The script must pass ``num_executors=<workers>`` (or leave it to default to
+``jax.process_count()``) and may use ``data_plane="local"`` for independent
+per-host replicas or initialize ``jax.distributed`` up front for one global
+mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import secrets
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--workers", type=int, default=2, help="total processes")
+    parser.add_argument("--host", default="127.0.0.1", help="driver host")
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if args.workers < 1:
+        parser.error("--workers must be >= 1")
+
+    port = _free_port()
+    secret = secrets.token_hex(16)
+    base_env = dict(os.environ)
+    base_env.update(
+        {
+            "MAGGY_TPU_DRIVER": f"{args.host}:{port}",
+            "MAGGY_TPU_SECRET": secret,
+            "MAGGY_TPU_NUM_EXECUTORS": str(args.workers),
+        }
+    )
+
+    procs = []
+    for rank in range(args.workers):
+        env = dict(base_env)
+        env["MAGGY_TPU_ROLE"] = "driver" if rank == 0 else "worker"
+        env["MAGGY_TPU_PARTITION"] = str(rank)
+        if rank == 0:
+            env["MAGGY_TPU_BIND_PORT"] = str(port)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, args.script, *args.script_args], env=env
+            )
+        )
+
+    exit_code = 0
+    try:
+        remaining = dict(enumerate(procs))
+        while remaining:
+            import time
+
+            for rank in list(remaining):
+                code = remaining[rank].poll()
+                if code is None:
+                    continue
+                del remaining[rank]
+                if code != 0:
+                    print(
+                        f"[maggy_tpu.run] rank {rank} exited with {code}; "
+                        "terminating remaining ranks",
+                        file=sys.stderr,
+                    )
+                    exit_code = exit_code or code
+                    # fail fast: a dead driver would otherwise leave workers
+                    # spinning in their connect-retry window
+                    for other in remaining.values():
+                        other.terminate()
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        exit_code = 130
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

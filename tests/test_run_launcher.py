@@ -1,0 +1,72 @@
+"""python -m maggy_tpu.run: the multi-process launcher forms one experiment
+from N copies of an unmodified user script."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from maggy_tpu import experiment
+    from maggy_tpu.config import DistributedConfig
+
+    def train(hparams, reporter, ctx):
+        reporter.broadcast(1.0, step=0)
+        return {{"metric": 2.5}}
+
+    result = experiment.lagom(
+        train,
+        DistributedConfig(
+            num_executors=3,
+            sharding="dp",
+            data_plane="local",
+            hb_interval=0.05,
+        ),
+    )
+    print("RESULT", result, flush=True)
+    """
+).format(repo=REPO)
+
+
+def test_run_launcher_three_processes(tmp_path):
+    script = tmp_path / "user_script.py"
+    script.write_text(SCRIPT)
+    env = dict(os.environ)
+    env["MAGGY_TPU_LOG_ROOT"] = str(tmp_path / "logs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "maggy_tpu.run", "--workers", "3", str(script)],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # driver's result aggregates all three workers
+    driver_lines = [l for l in proc.stdout.splitlines() if "num_workers" in l]
+    assert driver_lines, proc.stdout[-2000:]
+    assert "'num_workers': 3" in driver_lines[0]
+    assert "'metric': 2.5" in driver_lines[0]
+    # worker ranks report their role
+    assert proc.stdout.count("'role': 'worker'") == 2
+
+
+def test_run_launcher_arg_validation():
+    proc = subprocess.run(
+        [sys.executable, "-m", "maggy_tpu.run", "--workers", "0", "nope.py"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode != 0
+    assert "--workers" in proc.stderr
