@@ -226,7 +226,7 @@ class MoEDecoder(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 layer_cls,
-                variable_axes={"params": 0, "intermediates": 0},
+                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,

@@ -64,6 +64,44 @@ def test_cached_generation_matches_recompute(setup):
     assert (a == b).mean() > 0.95  # bf16 ties may break differently
 
 
+def test_moe_decoder_cached_generation():
+    """The MoE decoder shares the Attention module, so KV-cache decode works
+    for it too. (Note: per-step routing never drops tokens — capacity >=
+    top_k at t=1 — so under congestion decode can be *more* faithful than the
+    capacity-limited training forward; uncongested they agree.)"""
+    from maggy_tpu.models import MoEConfig, MoEDecoder
+
+    cfg = MoEConfig.tiny_moe(max_seq_len=24)
+    model = MoEDecoder(cfg)
+    tokens = jnp.asarray(np.arange(12)[None, :] % cfg.vocab_size, dtype=jnp.int32)
+    variables = model.init(jax.random.key(3), tokens)
+    full = np.asarray(model.apply(variables, tokens))
+
+    decode_model = MoEDecoder(dataclasses.replace(cfg, decode=True))
+    cache = init_cache(decode_model, tokens)
+    outs = []
+    for p in range(12):
+        logits, mut = decode_model.apply(
+            {"params": variables["params"], "cache": cache},
+            tokens[:, p : p + 1],
+            jnp.full((1, 1), p, jnp.int32),
+            mutable=["cache"],
+        )
+        cache = mut["cache"]
+        outs.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(outs, 1), full, atol=3e-2)
+
+    prompt = np.zeros((1, 16), dtype=np.int32)
+    prompt[0, :4] = [1, 2, 3, 4]
+    a = np.asarray(generate(model, variables, jnp.asarray(prompt), jnp.asarray([4])))
+    b = np.asarray(
+        generate_cached(
+            decode_model, variables["params"], jnp.asarray(prompt), jnp.asarray([4])
+        )
+    )
+    assert (a == b).mean() > 0.9
+
+
 def test_cached_generation_eos(setup):
     cfg, model, decode_model, variables, _ = setup
     prompt = np.zeros((1, 16), dtype=np.int32)
