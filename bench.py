@@ -74,13 +74,15 @@ def bench_training_throughput(quick: bool = False, cpu_fallback: bool = False):
         # ~260M-param geometry: saturates one v5e chip's MXU without blowing
         # HBM; scales to more chips via fsdp automatically. remat is required
         # at this seq len: scanned layers would otherwise stack every layer's
-        # [S, S] attention residuals in HBM.
+        # [S, S] attention residuals in HBM. head_dim=128 (8 heads) is the
+        # MXU-native layout — it lets the Pallas flash fwd+bwd kernel engage
+        # on the training path (Llama-3 itself uses head_dim 128).
         cfg = DecoderConfig(
             vocab_size=32_000,
             d_model=1024,
             n_layers=8 if quick else 12,
-            n_heads=16,
-            n_kv_heads=16,
+            n_heads=8,
+            n_kv_heads=8,
             d_ff=4096,
             max_seq_len=1024,
             remat=True,

@@ -32,7 +32,12 @@ def train(model, dataset, hparams, reporter, ctx):
     trainer = ctx.trainer(model, optax.adamw(hparams["lr"]))
     state = trainer.make_state(jax.random.key(0), next(dataset))
     state, metrics = trainer.fit(
-        state, dataset, num_steps=hparams["steps"], reporter=reporter, report_every=10
+        state,
+        dataset,
+        num_steps=hparams["steps"],
+        reporter=reporter,
+        report_every=10,
+        metric_sign=-1.0,  # metric is -loss (direction="max")
     )
     return {"metric": -metrics["loss"], "loss": metrics["loss"]}
 

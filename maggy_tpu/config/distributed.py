@@ -40,6 +40,7 @@ class DistributedConfig(LagomConfig):
         driver_addr: Optional[str] = None,
         data_plane: str = "auto",
         worker_timeout: float = 1800.0,
+        coordinator_port: Optional[int] = None,
     ):
         """:param module: a flax ``nn.Module`` class, instance, or zero-arg factory —
             the analogue of the reference's torch module class argument
@@ -92,6 +93,13 @@ class DistributedConfig(LagomConfig):
         self.data_plane = data_plane
         # pod mode: abort the run if a registered worker goes silent this long
         self.worker_timeout = float(worker_timeout)
+        # jax.distributed coordinator port on worker 0's host. None derives a
+        # per-experiment port from the driver's RPC port so two concurrent pod
+        # experiments sharing worker-0's host never collide
+        # (MAGGY_TPU_COORDINATOR_PORT is a user-settable env override).
+        if coordinator_port is None and os.environ.get("MAGGY_TPU_COORDINATOR_PORT"):
+            coordinator_port = int(os.environ["MAGGY_TPU_COORDINATOR_PORT"])
+        self.coordinator_port = coordinator_port
 
     def resolve_sharding(self, num_devices: int) -> ShardingSpec:
         if isinstance(self.sharding, ShardingSpec):

@@ -94,7 +94,12 @@ class DistributedTrainingDriver(Driver):
         # look like a multi-host cluster to the executors
         if self.pod_mode and self.num_executors > 1 and spec:
             host = spec[0].get("host") or "127.0.0.1"
-            coordinator = f"{host}:{8476}"
+            # derive from the experiment's RPC port unless pinned on the
+            # config: concurrent experiments on one host get distinct ports
+            port = getattr(self.config, "coordinator_port", None) or (
+                1024 + (self.server.port + 1000) % 64000
+            )
+            coordinator = f"{host}:{port}"
         return {
             "type": "EXEC_CONFIG",
             "num_processes": self.num_executors,

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from maggy_tpu.models.transformer import (
+    REMAT_POLICIES,
     Attention,
     DecoderConfig,
     RMSNorm,
@@ -217,11 +218,11 @@ class MoEDecoder(nn.Module):
         x = jnp.asarray(embed, cfg.dtype)[tokens]
 
         layer_cls = _ScannedMoELayer
-        if cfg.remat:
+        if cfg.remat and not cfg.decode:  # no gradients (hence no remat) in decode
             layer_cls = nn.remat(
                 layer_cls,
                 prevent_cse=not cfg.scan_layers,
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=REMAT_POLICIES[cfg.remat_policy],
             )
         if cfg.scan_layers:
             x, _ = nn.scan(

@@ -37,6 +37,23 @@ def make_mesh(spec: ShardingSpec, devices: Optional[List] = None):
     return Mesh(arr, MESH_AXES)
 
 
+def ambient_mesh():
+    """The Mesh made current via ``with mesh:`` (None outside any context).
+
+    Lets shape-dispatching ops (e.g. auto_attention) discover the mesh a
+    Trainer step is tracing under without explicit plumbing. Guarded: the
+    accessor is private JAX API, and dispatchers treat None as "no mesh"
+    (falling back to fully-partitionable XLA ops), so a JAX reorganization
+    degrades performance, never correctness."""
+    try:
+        from jax._src.mesh import thread_resources
+
+        m = thread_resources.env.physical_mesh
+        return None if m.empty else m
+    except Exception:
+        return None
+
+
 def mesh_for(num_devices: Optional[int] = None, sharding="fsdp", devices=None):
     """Convenience: resolve a preset/spec against the available devices."""
     import jax

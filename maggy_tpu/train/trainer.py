@@ -186,6 +186,7 @@ class Trainer:
         reporter=None,
         report_every: int = 10,
         metric_key: str = "loss",
+        metric_sign: float = 1.0,
         checkpointer=None,
         checkpoint_every: int = 0,
         profile_dir: Optional[str] = None,
@@ -198,6 +199,14 @@ class Trainer:
         ``profile_dir`` captures a JAX/XLA profiler trace over
         ``profile_steps=(start, stop)`` (reference has no tracer, §5.1);
         ``checkpointer`` + ``checkpoint_every`` save the state periodically.
+
+        Reported values are ``metric_sign * metrics[metric_key]``. Broadcast
+        values MUST be the same quantity and orientation as the train_fn's
+        returned optimization metric — the driver's early stopping and trial
+        ranking compare the two directly. When the experiment runs with
+        ``direction="max"`` and the train_fn returns ``-loss``, pass
+        ``metric_sign=-1.0`` so live broadcasts match; there is no implicit
+        negation.
         """
         metrics = {}
         profiling = False
@@ -216,10 +225,8 @@ class Trainer:
                     profiling = False
                     profile_dir = None  # one capture per fit
                 if reporter is not None and (i + 1) % report_every == 0:
-                    value = float(metrics[metric_key])
-                    reporter.broadcast(
-                        -value if metric_key == "loss" else value, step=int(state.step)
-                    )
+                    value = metric_sign * float(metrics[metric_key])
+                    reporter.broadcast(value, step=int(state.step))
                 if checkpointer is not None and checkpoint_every and (
                     (i + 1) % checkpoint_every == 0
                 ):

@@ -2,9 +2,13 @@
 
 Capability parity with the reference ``maggy/trial.py`` (trial.py:24-176): the five
 states PENDING/SCHEDULED/RUNNING/ERROR/FINALIZED, a deterministic trial id (16-char
-md5 prefix over the sorted-params JSON — same scheme as trial.py:110-136 so ids are
-comparable across frameworks), thread-safe metric appends deduplicated by step, an
-early-stop flag, and JSON (de)serialization.
+md5 prefix over the sorted-params JSON — same scheme as trial.py:110-136 so
+*optimization* trial ids are comparable across frameworks; ablation trial ids are
+NOT comparable: the reference serializes groups via ``str(list(set))``
+(loco.py:249), which depends on set iteration order, so we use a deterministic
+``"|".join(sorted(group))`` under the ``ablated_component`` key instead),
+thread-safe metric appends deduplicated by step, an early-stop flag, and JSON
+(de)serialization.
 """
 
 from __future__ import annotations
@@ -14,6 +18,55 @@ import json
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _normalize_key(key: Any) -> str:
+    """Dict keys follow json.dumps coercion exactly (so ids stay bit-identical
+    to the reference's json.dumps output); arbitrary objects raise instead of
+    silently stringifying to a per-process repr."""
+    if isinstance(key, str):
+        return key
+    if isinstance(key, bool):
+        return "true" if key else "false"  # json.dumps key coercion
+    if isinstance(key, (int, float, np.integer, np.floating)):
+        return str(_normalize_value(key))
+    raise TypeError(
+        f"Trial param key {key!r} of type {type(key).__name__} is not "
+        "JSON-serializable; use str/int/float/bool keys"
+    )
+
+
+def _normalize_value(value: Any) -> Any:
+    """Coerce numpy/jax scalars and containers to JSON-native types so that
+    np.int64(5) and 5 hash to the same trial id and travel the RPC wire as
+    numbers, not strings. Non-JSON-native leaves raise, like the reference
+    (trial.py:110-136 json.dumps without a default)."""
+    if isinstance(value, (str, bool)) or value is None:
+        return value
+    if isinstance(value, (int, float)):
+        return value
+    if isinstance(value, np.integer):
+        return int(value)
+    if isinstance(value, np.floating):
+        return float(value)
+    if isinstance(value, np.ndarray):
+        if value.ndim == 0:
+            return _normalize_value(value.item())
+        return [_normalize_value(v) for v in value.tolist()]
+    if hasattr(value, "ndim") and hasattr(value, "item"):
+        # jax Arrays and other numpy-protocol arrays, any rank
+        arr = np.asarray(value)
+        return _normalize_value(arr.item() if arr.ndim == 0 else arr)
+    if isinstance(value, (list, tuple)):
+        return [_normalize_value(v) for v in value]
+    if isinstance(value, dict):
+        return {_normalize_key(k): _normalize_value(v) for k, v in value.items()}
+    raise TypeError(
+        f"Trial param value {value!r} of type {type(value).__name__} is not "
+        "JSON-serializable; use int/float/str/bool/None or containers thereof"
+    )
 
 
 class Trial:
@@ -33,9 +86,11 @@ class Trial:
     ):
         if not isinstance(params, dict):
             raise TypeError(f"Trial params must be a dict, got {type(params).__name__}")
-        self.params = dict(params)
+        self.params = _normalize_value(dict(params))
+        # params are normalized above; hash directly (compute_id re-normalizes
+        # for external callers passing raw dicts)
         self.trial_type = trial_type
-        self.trial_id = self.compute_id(self.params)
+        self.trial_id = self._id_of_normalized(self.params)
         self.status = Trial.PENDING
         self.info_dict = dict(info_dict or {})
 
@@ -56,8 +111,14 @@ class Trial:
         """16-char md5 prefix of the canonical params JSON — bit-identical to
         the reference's ids for JSON-native params (trial.py:110-136 uses
         ``json.dumps(params, sort_keys=True)`` with default separators; the
-        reference suite's expected value "3d1cc9fdb1d4d001" passes here)."""
-        canonical = json.dumps(params, sort_keys=True, default=str)
+        reference suite's expected value "3d1cc9fdb1d4d001" passes here).
+        Params are normalized first so numpy scalars hash like native ones;
+        non-serializable values raise TypeError like the reference."""
+        return Trial._id_of_normalized(_normalize_value(params))
+
+    @staticmethod
+    def _id_of_normalized(params: Dict[str, Any]) -> str:
+        canonical = json.dumps(params, sort_keys=True)
         return hashlib.md5(canonical.encode("utf-8")).hexdigest()[:16]
 
     # ------------------------------------------------------------------ lifecycle

@@ -89,7 +89,9 @@ def test_lagom_distributed_e2e(tmp_env):
     def train(model, dataset, hparams, reporter, ctx):
         trainer = ctx.trainer(model, optax.adamw(hparams["lr"]))
         state = trainer.make_state(jax.random.key(0), next(dataset))
-        state, metrics = trainer.fit(state, dataset, num_steps=20, reporter=reporter)
+        state, metrics = trainer.fit(
+            state, dataset, num_steps=20, reporter=reporter, metric_sign=-1.0
+        )
         return {"metric": -metrics["loss"], "loss": metrics["loss"]}
 
     dconf = DistributedConfig(
