@@ -34,4 +34,6 @@ def __getattr__(name):
         return importlib.import_module("maggy_tpu.tensorboard")
     if name == "callbacks":
         return importlib.import_module("maggy_tpu.callbacks")
+    if name == "initialize_data_plane":
+        return importlib.import_module("maggy_tpu.core.pod").initialize_data_plane
     raise AttributeError(f"module 'maggy_tpu' has no attribute {name!r}")

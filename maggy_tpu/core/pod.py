@@ -28,6 +28,52 @@ import time
 from typing import Any, Callable, Optional, Tuple
 
 
+def initialize_data_plane(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Form the global JAX data plane — call at the very top of a pod script,
+    before any other JAX use (the reference's MASTER_ADDR/NCCL rendezvous,
+    torch_dist_executor.py:121-140, as one explicit bootstrap call).
+
+    Arguments default from the launcher environment (MAGGY_TPU_COORDINATOR /
+    NUM_EXECUTORS / PARTITION, exported by ``python -m maggy_tpu.run
+    --global-mesh``); returns False (no-op) when no coordinator is configured,
+    so the same script runs single-process unchanged. On a CPU fleet (tests,
+    dev boxes) cross-process collectives go through gloo automatically.
+    """
+    coordinator = coordinator or os.environ.get("MAGGY_TPU_COORDINATOR")
+    if not coordinator:
+        return False
+    num_processes = int(
+        num_processes
+        if num_processes is not None
+        else os.environ.get("MAGGY_TPU_NUM_EXECUTORS", "1")
+    )
+    process_id = int(
+        process_id
+        if process_id is not None
+        else os.environ.get("MAGGY_TPU_PARTITION", "0")
+    )
+    if jax_backend_initialized():
+        raise RuntimeError(
+            "initialize_data_plane() must run before any JAX backend use "
+            "(move it to the top of the script, before model/data imports "
+            "that touch jax)."
+        )
+    import jax
+
+    # multi-process CPU collectives need the gloo transport; harmless when the
+    # resolved platform is TPU (the knob only affects the CPU backend), and the
+    # platform cannot be resolved before initialize without starting a backend
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator, num_processes=num_processes, process_id=process_id
+    )
+    return True
+
+
 def jax_backend_initialized() -> bool:
     """True if XLA backends already exist (without creating them)."""
     try:

@@ -35,6 +35,14 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--workers", type=int, default=2, help="total processes")
     parser.add_argument("--host", default="127.0.0.1", help="driver host")
+    parser.add_argument(
+        "--global-mesh",
+        action="store_true",
+        help="export a jax.distributed coordinator so the script can call "
+        "maggy_tpu.initialize_data_plane() and form ONE mesh over all "
+        "processes (the multi-host data plane); without it each process "
+        "keeps a host-local backend",
+    )
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -51,6 +59,8 @@ def main(argv=None) -> int:
             "MAGGY_TPU_NUM_EXECUTORS": str(args.workers),
         }
     )
+    if args.global_mesh:
+        base_env["MAGGY_TPU_COORDINATOR"] = f"{args.host}:{_free_port()}"
 
     procs = []
     for rank in range(args.workers):

@@ -1,3 +1,11 @@
 from maggy_tpu.train.trainer import Trainer, TrainContext, lm_loss_fn, classification_loss_fn
+from maggy_tpu.train.sharded_dataset import ShardedDataset, write_sharded
 
-__all__ = ["Trainer", "TrainContext", "lm_loss_fn", "classification_loss_fn"]
+__all__ = [
+    "Trainer",
+    "TrainContext",
+    "lm_loss_fn",
+    "classification_loss_fn",
+    "ShardedDataset",
+    "write_sharded",
+]

@@ -64,6 +64,41 @@ def _native_lib() -> Optional[ctypes.CDLL]:
     return _LIB
 
 
+def perm_indices(lib: Optional[ctypes.CDLL], n: int, seed: int) -> np.ndarray:
+    """Permutation of [0, n) from the native RNG (numpy fallback)."""
+    if lib is not None:
+        out = np.empty(n, dtype=np.int64)
+        lib.mtl_perm(
+            n,
+            ctypes.c_uint64(seed & (2**64 - 1)),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
+    return np.random.default_rng(seed).permutation(n).astype(np.int64)
+
+
+def gather_rows(
+    lib: Optional[ctypes.CDLL],
+    arr: np.ndarray,
+    idx: np.ndarray,
+    threads: int = 4,
+) -> np.ndarray:
+    """Row gather via the C++ library (GIL released); numpy fallback."""
+    if lib is None or not arr.flags.c_contiguous:
+        return np.asarray(arr[idx])
+    row_bytes = arr.dtype.itemsize * int(np.prod(arr.shape[1:], dtype=np.int64))
+    out = np.empty((len(idx),) + arr.shape[1:], dtype=arr.dtype)
+    lib.mtl_gather(
+        arr.ctypes.data_as(ctypes.c_void_p),
+        row_bytes,
+        idx.ctypes.data_as(ctypes.c_void_p),
+        len(idx),
+        out.ctypes.data_as(ctypes.c_void_p),
+        threads,
+    )
+    return out
+
+
 class NativeBatchLoader:
     """Iterator of shuffled dict batches over host arrays.
 
@@ -116,32 +151,10 @@ class NativeBatchLoader:
     def _perm(self, epoch: int) -> np.ndarray:
         if not self.shuffle:
             return np.arange(self.n, dtype=np.int64)
-        if self._lib is not None:
-            out = np.empty(self.n, dtype=np.int64)
-            self._lib.mtl_perm(
-                self.n,
-                ctypes.c_uint64(self.seed * 1_000_003 + epoch),
-                out.ctypes.data_as(ctypes.c_void_p),
-            )
-            return out
-        return np.random.default_rng(self.seed * 1_000_003 + epoch).permutation(
-            self.n
-        ).astype(np.int64)
+        return perm_indices(self._lib, self.n, self.seed * 1_000_003 + epoch)
 
     def _gather(self, arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
-        if self._lib is None:
-            return arr[idx]
-        row_bytes = arr.dtype.itemsize * int(np.prod(arr.shape[1:], dtype=np.int64))
-        out = np.empty((len(idx),) + arr.shape[1:], dtype=arr.dtype)
-        self._lib.mtl_gather(
-            arr.ctypes.data_as(ctypes.c_void_p),
-            row_bytes,
-            idx.ctypes.data_as(ctypes.c_void_p),
-            len(idx),
-            out.ctypes.data_as(ctypes.c_void_p),
-            self.gather_threads,
-        )
-        return out
+        return gather_rows(self._lib, arr, idx, self.gather_threads)
 
     # ------------------------------------------------------------------ interface
 

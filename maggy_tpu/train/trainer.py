@@ -100,15 +100,46 @@ class Trainer:
         abstract = jax.eval_shape(init_fn, rng, *inputs)
         self.state_shardings = shd.params_shardings(self.mesh, abstract, self.rules)
         init = jax.jit(init_fn, out_shardings=self.state_shardings)
+        import numpy as np
+
+        # np (not jnp): host values enter a multi-process jit as replicated
+        # inputs instead of arrays committed to one process's local device
         with self.mesh:
-            return init(rng, *jax.tree.map(jnp.asarray, inputs))
+            return init(rng, *jax.tree.map(np.asarray, inputs))
 
     def batch_shardings(self, batch):
         return jax.tree.map(lambda _: shd.batch_sharding(self.mesh, self.rules), batch)
 
-    def shard_batch(self, batch):
-        """Place a host batch onto the mesh, batch axis over (data, fsdp)."""
-        return jax.device_put(batch, self.batch_shardings(batch))
+    def shard_batch(self, batch, *, local: bool = False):
+        """Place a host batch onto the mesh, batch axis over (data, fsdp).
+
+        Single-process: a plain sharded device_put. Multi-process (global
+        mesh formed via ``initialize_data_plane``): every process passes the
+        same *global* batch and this slices out its own rows before assembly
+        — so train_fns stay oblivious to the process topology. A loader that
+        already rank-shards its stream (petastorm semantics — reference
+        dataloader.py:116-131) passes ``local=True`` to skip the slicing.
+        """
+        shardings = self.batch_shardings(batch)
+        if jax.process_count() == 1:
+            return jax.device_put(batch, shardings)
+        import numpy as np
+
+        pid, n = jax.process_index(), jax.process_count()
+
+        def put(x, s):
+            x = np.asarray(x)
+            if not local:
+                if x.shape[0] % n:
+                    raise ValueError(
+                        f"Global batch dim {x.shape[0]} not divisible by "
+                        f"{n} processes"
+                    )
+                per = x.shape[0] // n
+                x = x[pid * per : (pid + 1) * per]
+            return jax.make_array_from_process_local_data(s, x)
+
+        return jax.tree.map(put, batch, shardings)
 
     # ------------------------------------------------------------------ steps
 

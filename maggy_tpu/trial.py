@@ -22,16 +22,18 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 
-def _normalize_key(key: Any) -> str:
-    """Dict keys follow json.dumps coercion exactly (so ids stay bit-identical
-    to the reference's json.dumps output); arbitrary objects raise instead of
-    silently stringifying to a per-process repr."""
-    if isinstance(key, str):
+def _normalize_key(key: Any):
+    """Keys stay native (str/int/float/bool — numpy scalars coerced) so that
+    ``json.dumps(..., sort_keys=True)`` sorts and stringifies them exactly
+    like the reference does (int keys sort numerically, not lexically);
+    arbitrary objects raise instead of silently stringifying to a
+    per-process repr."""
+    if isinstance(key, (str, bool, int, float)):
         return key
-    if isinstance(key, bool):
-        return "true" if key else "false"  # json.dumps key coercion
-    if isinstance(key, (int, float, np.integer, np.floating)):
-        return str(_normalize_value(key))
+    if isinstance(key, np.integer):
+        return int(key)
+    if isinstance(key, np.floating):
+        return float(key)
     raise TypeError(
         f"Trial param key {key!r} of type {type(key).__name__} is not "
         "JSON-serializable; use str/int/float/bool keys"

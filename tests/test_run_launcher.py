@@ -60,6 +60,122 @@ def test_run_launcher_three_processes(tmp_path):
     assert proc.stdout.count("'role': 'worker'") == 2
 
 
+GLOBAL_MESH_SCRIPT = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import maggy_tpu
+    formed = maggy_tpu.initialize_data_plane()
+    assert formed, "launcher should have exported MAGGY_TPU_COORDINATOR"
+    assert jax.process_count() == int(os.environ["MAGGY_TPU_NUM_EXECUTORS"]), (
+        jax.process_count()
+    )
+
+    import optax
+    from maggy_tpu import experiment
+    from maggy_tpu.config import DistributedConfig
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.train.data import synthetic_lm_batches
+
+    CFG = DecoderConfig.tiny()
+
+    def train(model, dataset, hparams, reporter, ctx):
+        assert ctx.num_processes == 2 and len(ctx.mesh.devices.flat) == 2
+        trainer = ctx.trainer(model, optax.adamw(3e-3))
+        state = trainer.make_state(jax.random.key(0), next(dataset))
+        last = None
+        for _ in range(5):
+            # every process sees the same global batch; shard_batch slices
+            state, m = trainer.step(state, trainer.shard_batch(next(dataset)))
+            last = float(m["loss"])
+        return {{"metric": last, "loss": last}}
+
+    result = experiment.lagom(
+        train,
+        DistributedConfig(
+            module=Decoder(CFG),
+            dataset=synthetic_lm_batches(CFG.vocab_size, 8, 32, seed=7),
+            sharding="dp",
+            data_plane="auto",
+            hb_interval=0.05,
+        ),
+    )
+    if jax.process_index() == 0:
+        with open(os.environ["MT_RESULT_FILE"], "w") as f:
+            json.dump(result, f)
+    print("GLOBAL_MESH_OK", flush=True)
+    """
+).format(repo=REPO)
+
+
+def test_run_launcher_global_mesh(tmp_path):
+    """Two launcher processes form ONE jax.distributed mesh (process_count==2)
+    and train with the same loss as a single-process run over the same data —
+    the multi-host data-plane proof (NCCL/MASTER_ADDR rendezvous parity)."""
+    script = tmp_path / "global_mesh_script.py"
+    script.write_text(GLOBAL_MESH_SCRIPT)
+    result_file = tmp_path / "result.json"
+    env = dict(os.environ)
+    env["MAGGY_TPU_LOG_ROOT"] = str(tmp_path / "logs")
+    env["MT_RESULT_FILE"] = str(result_file)
+    # conftest's 8-device flag must not leak: 1 local device per process
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "maggy_tpu.run",
+            "--workers", "2", "--global-mesh", str(script),
+        ],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-2500:])
+    assert proc.stdout.count("GLOBAL_MESH_OK") == 2
+    import json
+
+    multi = json.load(result_file.open())
+
+    # same training single-process on a 1-device mesh with the same global batch
+    single = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(
+            f"""
+            import sys; sys.path.insert(0, {REPO!r})
+            import os; os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax; jax.config.update("jax_platforms", "cpu")
+            import optax
+            from maggy_tpu.models import Decoder, DecoderConfig
+            from maggy_tpu.train import TrainContext
+            from maggy_tpu.train.data import synthetic_lm_batches
+            CFG = DecoderConfig.tiny()
+            ctx = TrainContext.create("dp")
+            trainer = ctx.trainer(Decoder(CFG), optax.adamw(3e-3))
+            data = synthetic_lm_batches(CFG.vocab_size, 8, 32, seed=7)
+            state = trainer.make_state(jax.random.key(0), next(data))
+            for _ in range(5):
+                state, m = trainer.step(state, trainer.shard_batch(next(data)))
+            print("SINGLE_LOSS", float(m["loss"]))
+            """
+        )],
+        env={
+            **{k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+            "MAGGY_TPU_LOG_ROOT": str(tmp_path / "logs1"),
+        },
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert single.returncode == 0, single.stderr[-2000:]
+    single_loss = float(single.stdout.split("SINGLE_LOSS")[1].strip().split()[0])
+    assert abs(multi["loss"] - single_loss) < 2e-4, (multi["loss"], single_loss)
+
+
 def test_run_launcher_arg_validation():
     proc = subprocess.run(
         [sys.executable, "-m", "maggy_tpu.run", "--workers", "0", "nope.py"],
