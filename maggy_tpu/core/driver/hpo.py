@@ -80,6 +80,14 @@ class HyperparameterOptDriver(Driver):
             1, min(config.num_executors or len(groups), self.num_trials)
         )
 
+    def _exp_startup_callback(self) -> None:
+        # HParams plugin experiment config (reference tensorboard.py:47-102):
+        # written once per experiment so the TB dashboard gets typed columns
+        from maggy_tpu import tensorboard as tb
+
+        if len(self.config.searchspace):
+            tb.write_hparams_config(self.exp_dir, self.config.searchspace)
+
     def _make_pruner(self, config):
         if config.pruner is None:
             return None

@@ -83,20 +83,57 @@ def _sample(last, rng, temperature: float, top_k: int):
     return jax.random.categorical(sub, scaled, axis=-1), rng
 
 
-def init_cache(decode_model, prompt: jax.Array):
+def cache_shardings(mesh, abstract_cache, rules=None):
+    """NamedShardings for a decode KV cache: batch over (data, fsdp), KV heads
+    over tensor when divisible — so tensor-parallel decode holds 1/tp of each
+    cache instead of a full replica (round-1 verdict weak #7). Cache leaves
+    are ``[..., B, S, Kh, Dh]`` (a leading layer axis when scanned); anything
+    smaller (the write index) replicates.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from maggy_tpu.parallel import sharding as shd
+    from maggy_tpu.parallel.spec import AXIS_TENSOR
+
+    rules = rules or shd.DEFAULT_RULES
+    batch_axes = shd.logical_to_mesh_axes(("batch",), rules)[0]
+    tp = mesh.shape[AXIS_TENSOR]
+
+    def leaf(s):
+        if s.ndim >= 4:
+            kv = AXIS_TENSOR if (tp > 1 and s.shape[-2] % tp == 0) else None
+            lead = (None,) * (s.ndim - 4)
+            return NamedSharding(
+                mesh, PartitionSpec(*lead, batch_axes, None, kv, None)
+            )
+        return NamedSharding(mesh, PartitionSpec())
+
+    return jax.tree.map(leaf, abstract_cache)
+
+
+def init_cache(decode_model, prompt: jax.Array, mesh=None, rules=None):
     """Create the zeroed KV cache for a ``DecoderConfig(decode=True)`` model.
 
     ``eval_shape`` gives the cache structure without running the model — an
     actual ``init`` would execute the decode forward pass, writing throwaway
     K/V into slot 0 and advancing the index, corrupting every later write.
+
+    With ``mesh``, every cache leaf is born sharded per
+    :func:`cache_shardings` (never materialized replicated on one device).
     """
     dummy_pos = jnp.zeros((prompt.shape[0], 1), jnp.int32)
     abstract = jax.eval_shape(
         decode_model.init, jax.random.key(0), prompt[:, :1], dummy_pos
+    )["cache"]
+    if mesh is None:
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract)
+    shardings = cache_shardings(mesh, abstract, rules)
+    zeros = jax.jit(
+        lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract),
+        out_shardings=shardings,
     )
-    return jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"]
-    )
+    with mesh:
+        return zeros()
 
 
 @functools.partial(

@@ -37,12 +37,16 @@ class BaseAsyncBO(AbstractOptimizer):
         budget-augmented final metrics z=[x, b/b_max] using ALL observations.
         ``interim_rows > 0`` additionally emits up to that many rows per trial
         from its heartbeat metric history at fractional budgets — the
-        reference's interim-results augmentation (bayes/base.py:459-641)."""
+        reference's interim-results augmentation (bayes/base.py:459-641).
+        ``imputation="kb"`` is kriging believer (reference gp.py:329-373):
+        busy trials are imputed at the surrogate's posterior mean rather than
+        a constant — surrogate-specific, provided by GP via
+        :meth:`_impute_busy`."""
         super().__init__(**kwargs)
         if not 0 <= random_fraction <= 1:
             raise ValueError("random_fraction must be in [0, 1]")
-        if imputation not in ("cl_min", "cl_max", "cl_mean"):
-            raise ValueError("imputation must be one of cl_min/cl_max/cl_mean")
+        if imputation not in ("cl_min", "cl_max", "cl_mean", "kb"):
+            raise ValueError("imputation must be one of cl_min/cl_max/cl_mean/kb")
         if multi_fidelity not in ("per_rung", "augment"):
             raise ValueError("multi_fidelity must be per_rung or augment")
         self.num_warmup_trials = int(num_warmup_trials)
@@ -136,7 +140,6 @@ class BaseAsyncBO(AbstractOptimizer):
             X_parts.append(X_done)
             y_parts.append(y_done)
         if y_done.size and self.trial_store:
-            liar = self._liar(y_done)
             busy = self.searchspace.transform_many(
                 [
                     self._strip_budget(t.params)
@@ -146,7 +149,7 @@ class BaseAsyncBO(AbstractOptimizer):
             )
             if busy.size:
                 X_parts.append(busy)
-                y_parts.append(np.full(busy.shape[0], liar))
+                y_parts.append(self._impute_busy(X_done, y_done, busy))
         if not X_parts:
             return None, None
         return np.concatenate(X_parts), np.concatenate(y_parts)
@@ -172,12 +175,21 @@ class BaseAsyncBO(AbstractOptimizer):
         return target_budget
 
     def _liar(self, y_done: np.ndarray) -> float:
-        """Constant-liar value for busy-trial imputation."""
+        """Constant-liar value for busy-trial imputation ("kb" surrogates
+        override :meth:`_impute_busy`; the mean is their fallback)."""
         return {
             "cl_min": float(y_done.min()),
             "cl_max": float(y_done.max()),
             "cl_mean": float(y_done.mean()),
+            "kb": float(y_done.mean()),
         }[self.imputation]
+
+    def _impute_busy(
+        self, X_done: np.ndarray, y_done: np.ndarray, X_busy: np.ndarray
+    ) -> np.ndarray:
+        """Imputed y for in-flight configs: constant liar by default;
+        surrogates supporting kriging believer override this."""
+        return np.full(X_busy.shape[0], self._liar(y_done))
 
     def _augmented_training_set(self, target_budget: Optional[float]):
         """[x, b/b_max] design over ALL observations + busy imputation; returns
@@ -199,9 +211,9 @@ class BaseAsyncBO(AbstractOptimizer):
             dtype=np.float64,
         )
         X_aug = np.concatenate([X, b[:, None]], axis=1)
-        # busy-trial liar comes from FINAL metrics only, before interim rows
-        # dilute y with early-training values
-        liar = self._liar(y) if self.trial_store and y.size else None
+        # busy-trial imputation learns from FINAL metrics only, before interim
+        # rows dilute y with early-training values
+        X_final, y_final = (X_aug, y) if self.trial_store and y.size else (None, None)
         if self.interim_rows > 0:
             # interim observations: the metric after the j-th of n heartbeats of
             # a budget-b trial sits at fractional budget (j+1)/n * b/b_max —
@@ -225,18 +237,19 @@ class BaseAsyncBO(AbstractOptimizer):
             if extra_X:
                 X_aug = np.concatenate([X_aug, np.stack(extra_X)])
                 y = np.concatenate([y, np.asarray(extra_y, dtype=np.float64)])
-        if self.trial_store:
+        if self.trial_store and X_final is not None:
             busy = list(self.trial_store.values())
             Xb = self.searchspace.transform_many(
                 [self._strip_budget(t.params) for t in busy]
             )
             bb = np.asarray(
-                [t.params.get("budget", max_b) / max_b for t in busy], dtype=np.float64
+                [t.params.get("budget", max_b) / max_b for t in busy],
+                dtype=np.float64,
             )
-            X_aug = np.concatenate(
-                [X_aug, np.concatenate([Xb, bb[:, None]], axis=1)]
-            )
-            y = np.concatenate([y, np.full(len(busy), liar)])
+            if Xb.size:
+                Xb_aug = np.concatenate([Xb, bb[:, None]], axis=1)
+                X_aug = np.concatenate([X_aug, Xb_aug])
+                y = np.concatenate([y, self._impute_busy(X_final, y_final, Xb_aug)])
         b_norm = (target_budget / max_b) if target_budget else 1.0
         return X_aug, y, float(min(b_norm, 1.0))
 

@@ -66,7 +66,13 @@ def _norm_cdf(z):
 
 
 class GP(BaseAsyncBO):
-    """Async GP-BO. ``acq_fun`` in {"ei", "pi", "lcb"}; minimizes internally."""
+    """Async GP-BO. ``acq_fun`` in {"ei", "pi", "lcb", "asy_ts"}; minimizes
+    internally. ``asy_ts`` is asynchronous Thompson sampling (reference
+    gp.py:158-162): every proposal draws one function sample from the GP
+    posterior over a candidate set and takes its argmin — naturally diverse
+    under parallel workers, no liar needed. ``imputation="kb"`` (kriging
+    believer, reference gp.py:329-373) imputes busy trials at the posterior
+    mean of a GP fitted on the finished observations."""
 
     def __init__(
         self,
@@ -78,8 +84,8 @@ class GP(BaseAsyncBO):
         **kwargs,
     ):
         super().__init__(**kwargs)
-        if acq_fun not in ("ei", "pi", "lcb"):
-            raise ValueError("acq_fun must be ei, pi or lcb")
+        if acq_fun not in ("ei", "pi", "lcb", "asy_ts"):
+            raise ValueError("acq_fun must be ei, pi, lcb or asy_ts")
         self.acq_fun = acq_fun
         self.acq_samples = int(acq_samples)
         self.kappa = kappa
@@ -149,6 +155,30 @@ class GP(BaseAsyncBO):
             return -ei
         return -_norm_cdf(z)  # pi
 
+    def _thompson_draw(self, model: _FittedGP, Xs: np.ndarray) -> np.ndarray:
+        """One joint sample from the GP posterior at ``Xs`` (standardized y
+        space is fine — argmin is scale-invariant)."""
+        mu, _ = model.predict(Xs)
+        Ks = model.amp2 * _matern52(Xs, model.X, model.lengthscales)
+        v = np.linalg.solve(model.L, Ks.T)
+        cov = (
+            model.amp2 * _matern52(Xs, Xs, model.lengthscales)
+            - v.T @ v
+            + 1e-8 * np.eye(len(Xs))
+        )
+        Lp = np.linalg.cholesky(cov)
+        return mu + (Lp @ self.rng.standard_normal(len(Xs))) * model.y_std
+
+    def _impute_busy(self, X_done, y_done, X_busy) -> np.ndarray:
+        if self.imputation != "kb":
+            return super()._impute_busy(X_done, y_done, X_busy)
+        try:
+            believer = self.fit_model(X_done, y_done)
+            mu, _ = believer.predict(X_busy)
+            return np.asarray(mu)
+        except Exception:  # singular kernel etc. — constant fallback
+            return super()._impute_busy(X_done, y_done, X_busy)
+
     def sample_from_model(self, model: _FittedGP, fixed_last=None) -> np.ndarray:
         d = model.X.shape[1]
         d_free = d - 1 if fixed_last is not None else d
@@ -158,6 +188,13 @@ class GP(BaseAsyncBO):
                 return x_free
             pad = np.full((*x_free.shape[:-1], 1), fixed_last)
             return np.concatenate([x_free, pad], axis=-1)
+
+        if self.acq_fun == "asy_ts":
+            # joint posterior sampling is O(n^3) in the candidate count
+            n = min(self.acq_samples, 512)
+            Xs = self.rng.random((n, d_free))
+            draw = self._thompson_draw(model, embed(Xs))
+            return Xs[int(np.argmin(draw))]
 
         Xs = self.rng.random((self.acq_samples, d_free))
         acq = self._acquisition(model, embed(Xs))

@@ -1,4 +1,4 @@
-"""Pipeline parallelism: GPipe-style fill/drain schedule over the ``stage`` axis.
+"""Pipeline parallelism over the ``stage`` mesh axis: GPipe + 1F1B.
 
 The reference explicitly rejects pipeline modules (core/patching/modules.py:
 106-109 asserts against DeepSpeed PipelineModule); SURVEY.md §2.10 marks PP a
@@ -7,11 +7,24 @@ devices along the ``stage`` mesh axis, activations flow stage→stage via
 ``ppermute`` (point-to-point — DCN-friendly, hence the axis sits outermost in
 MESH_AXES), and microbatches keep every stage busy after the fill phase.
 
-Schedule (classic GPipe, no 1F1B): with S stages and M microbatches the loop
-runs M + S - 1 ticks; at tick t stage s processes microbatch t - s. Backward
-flows through the same schedule by autodiff (ppermute's transpose is the
-reverse permute), so one ``jax.grad`` around :func:`pipeline_apply` trains the
-whole pipeline.
+Two schedules:
+
+* :func:`pipeline_apply` — classic GPipe: with S stages and M microbatches the
+  loop runs M + S - 1 ticks; at tick t stage s processes microbatch t - s.
+  Backward flows through the same schedule by autodiff (ppermute's transpose
+  is the reverse permute), so one ``jax.grad`` trains the pipeline — but the
+  scan's autodiff residuals grow with the tick count × carry size.
+* :func:`pipeline_grads_1f1b` — an explicit one-forward-one-backward training
+  schedule (PipeDream-flush order) with per-microbatch rematerialisation:
+  each stage keeps only its in-flight stage *inputs* (an S+1-slot ring
+  buffer) and re-linearises at backward time, so activation memory is O(S)
+  per stage instead of O(M) — the long-context setting. Closed-form SPMD
+  clock, derivable from the dependency chain: backward of microbatch m at
+  stage s fires at tick ``2S-1-s+2m``; its forward at ``s+m`` during warmup
+  (m ≤ S-1-s) and ``2m+s`` in steady state. Each stage performs at most one
+  op per tick (fwd/bwd tick parities are opposite), activation hand-offs are
+  buffered in the ring, and gradient hand-offs always arrive exactly one
+  tick before their consumer — so a single carried buffer suffices.
 """
 
 from __future__ import annotations
@@ -32,6 +45,7 @@ def pipeline_apply(
     *,
     mesh,
     axis_name: str = AXIS_STAGE,
+    out_mode: str = "replicated",
 ):
     """Run a layer pipeline over the mesh's ``stage`` axis.
 
@@ -42,8 +56,16 @@ def pipeline_apply(
     :param microbatches: ``[n_micro, mb, ...]`` activations; the ``mb`` axis is
         sharded over (data, fsdp), so a pp x dp mesh pipelines AND
         data-parallelizes (each dp replica pipelines its batch slice).
-    :returns: ``[n_micro, mb, ...]`` outputs of the final stage.
+    :param out_mode: ``"replicated"`` all-reduces the full output buffer so
+        every stage holds it (API-compatible default); ``"scatter"`` instead
+        reduce-scatters the ``n_micro`` axis over stages — ~2x less interconnect
+        traffic, right when the consumer (a loss) reduces anyway. Requires
+        ``n_micro % n_stages == 0``.
+    :returns: ``[n_micro, mb, ...]`` outputs of the final stage
+        (``[n_micro / n_stages, mb, ...]`` per stage for ``"scatter"``).
     """
+    if out_mode not in ("replicated", "scatter"):
+        raise ValueError(f"out_mode must be 'replicated' or 'scatter', got {out_mode!r}")
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
         return jax.vmap(lambda x: stage_fn(jax.tree.map(lambda p: p[0], stage_params), x))(
@@ -83,18 +105,205 @@ def pipeline_apply(
         (_, outputs), _ = jax.lax.scan(
             tick, init, jnp.arange(n_micro + n_stages - 1)
         )
-        # only the last stage holds real outputs; psum broadcasts them
+        # only the last stage holds real outputs
         outputs = jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs))
+        if out_mode == "scatter":
+            # reduce-scatter the micro axis over stages: each stage keeps its
+            # n_micro/S chunk instead of an all-reduced full buffer
+            return jax.lax.psum_scatter(
+                outputs, axis_name, scatter_dimension=0, tiled=True
+            )
         return jax.lax.psum(outputs, axis_name)
+
+    batch_spec = P(None, (AXIS_DATA, AXIS_FSDP))
+    if out_mode == "scatter":
+        if n_stages > 1 and n_micro % n_stages:
+            raise ValueError(
+                f"out_mode='scatter' needs n_micro ({n_micro}) divisible by "
+                f"stages ({n_stages})"
+            )
+        out_spec = P(axis_name, (AXIS_DATA, AXIS_FSDP))
+    else:
+        out_spec = batch_spec
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis_name), batch_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )(stage_params, microbatches)
+
+
+def pipeline_grads_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    targets,
+    *,
+    mesh,
+    axis_name: str = AXIS_STAGE,
+):
+    """One training step with the 1F1B schedule: returns ``(loss, grads)``.
+
+    :param stage_fn: ``fn(params_for_one_stage, x) -> y``, activation-shape and
+        dtype preserving.
+    :param loss_fn: ``fn(y_final, target) -> scalar`` — mean loss of ONE
+        microbatch (computed on the last stage only; no output buffer ever
+        forms, let alone gets broadcast).
+    :param stage_params: leaves ``[n_stages, ...]`` (see
+        :func:`stack_stage_params`).
+    :param microbatches: ``[n_micro, mb, ...]``; ``targets`` any pytree of
+        ``[n_micro, ...]`` leaves consumed by ``loss_fn``.
+    :returns: ``loss`` — mean over all microbatches (replicated), and
+        ``grads`` — same structure/sharding as ``stage_params``.
+
+    Memory: each stage stores its in-flight stage inputs in an (S+1)-slot
+    ring and re-linearises (recompute + VJP) at its backward tick — O(S)
+    activations per stage versus GPipe-autodiff's O(ticks) scan residuals.
+    """
+    S = mesh.shape[axis_name]
+    M = microbatches.shape[0]
+    if S == 1:
+        def loss_all(params):
+            p0 = jax.tree.map(lambda q: q[0], params)
+            losses = jax.vmap(
+                lambda x, t: loss_fn(stage_fn(p0, x), t)
+            )(microbatches, targets)
+            return losses.mean()
+
+        return jax.value_and_grad(loss_all)(stage_params)
+    if M < S:
+        raise ValueError(
+            f"Need at least as many microbatches ({M}) as stages ({S})."
+        )
+    RING = S + 1  # in-flight inputs per stage are bounded by S (see proof in tests)
+    T = 2 * M + 2 * S - 2
+
+    def local(params, mbs, tgts):
+        params = jax.tree.map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis_name)
+        is_last = stage == S - 1
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+        zeros_mb = jnp.zeros(mbs.shape[1:], mbs.dtype)
+        zero_dp = jax.tree.map(jnp.zeros_like, params)
+
+        def fwd_micro(t, s):
+            """Which microbatch (if any) stage s forwards at tick t."""
+            warm = t - s
+            is_warm = (warm >= 0) & (warm <= S - 1 - s) & (warm < M)
+            bey = (t - s) // 2
+            is_bey = (
+                ((t - s) >= 0)
+                & ((t - s) % 2 == 0)
+                & (bey > S - 1 - s)
+                & (bey < M)
+            )
+            return jnp.where(is_warm, warm, bey), is_warm | is_bey
+
+        def bwd_micro(t, s):
+            tb = t - (2 * S - 1 - s)
+            return tb // 2, (tb >= 0) & (tb % 2 == 0) & (tb // 2 < M)
+
+        def pick(buf, mbs_idx, ring_idx):
+            """stage 0 reads the microbatch stream; others read the ring."""
+            from_mbs = jax.lax.dynamic_index_in_dim(mbs, mbs_idx, keepdims=False)
+            from_ring = jax.lax.dynamic_index_in_dim(buf, ring_idx, keepdims=False)
+            return jnp.where(stage == 0, from_mbs, from_ring)
+
+        def tick(carry, t):
+            xbuf, y_recv, g_recv, grad_acc, loss_acc = carry
+
+            # 1. bank last tick's arriving activation into the ring
+            m_arr, ok_arr = fwd_micro(t - 1, stage - 1)
+            ok_arr = ok_arr & (stage > 0) & (t > 0)
+            slot = jnp.clip(m_arr, 0, M - 1) % RING
+            xbuf = jnp.where(
+                ok_arr,
+                jax.lax.dynamic_update_index_in_dim(xbuf, y_recv, slot, 0),
+                xbuf,
+            )
+
+            # 2. forward op (at most one per tick)
+            m_f, do_f = fwd_micro(t, stage)
+            mf = jnp.clip(m_f, 0, M - 1)
+            x_in = pick(xbuf, mf, mf % RING)
+            y = jax.lax.cond(
+                do_f,
+                lambda x: stage_fn(params, x),
+                lambda x: jnp.zeros_like(x),
+                x_in,
+            )
+
+            # 3. backward op: re-linearise from the saved stage input
+            m_b, do_b = bwd_micro(t, stage)
+            mb_ = jnp.clip(m_b, 0, M - 1)
+            x_sv = pick(xbuf, mb_, mb_ % RING)
+            tgt = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, mb_, keepdims=False),
+                tgts,
+            )
+
+            def run_bwd(x, g):
+                def last_fn(x, g):
+                    lval, pull = jax.vjp(
+                        lambda p, xx: loss_fn(stage_fn(p, xx), tgt), params, x
+                    )
+                    dp, dx = pull(jnp.ones_like(lval))
+                    return dp, dx, lval.astype(jnp.float32)
+
+                def mid_fn(x, g):
+                    yv, pull = jax.vjp(stage_fn, params, x)
+                    dp, dx = pull(g.astype(yv.dtype))
+                    return dp, dx, jnp.float32(0)
+
+                return jax.lax.cond(is_last, last_fn, mid_fn, x, g)
+
+            def skip_bwd(x, g):
+                return zero_dp, zeros_mb, jnp.float32(0)
+
+            dp, dx, lval = jax.lax.cond(do_b, run_bwd, skip_bwd, x_sv, g_recv)
+            grad_acc = jax.tree.map(lambda a, d: a + d, grad_acc, dp)
+            loss_acc = loss_acc + lval
+
+            # 4. hand off: activations forward, gradients backward
+            y_next = jax.lax.ppermute(y, axis_name, fwd_perm)
+            g_next = jax.lax.ppermute(dx, axis_name, bwd_perm)
+            return (xbuf, y_next, g_next, grad_acc, loss_acc), None
+
+        init = (
+            jnp.zeros((RING,) + mbs.shape[1:], mbs.dtype),
+            zeros_mb,
+            zeros_mb,
+            zero_dp,
+            jnp.float32(0),
+        )
+        (_, _, _, grad_acc, loss_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(T)
+        )
+
+        # data-parallel mean over (data, fsdp) replicas, micro mean over M;
+        # loss lives on the last stage only — psum over stage broadcasts it
+        dpf = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+        grads = jax.tree.map(
+            lambda g: (
+                jax.lax.psum(g, (AXIS_DATA, AXIS_FSDP)) / (dpf * M)
+            )[None],
+            grad_acc,
+        )
+        loss = jax.lax.psum(loss_acc, axis_name)
+        loss = jax.lax.psum(loss, (AXIS_DATA, AXIS_FSDP)) / (dpf * M)
+        return loss, grads
 
     batch_spec = P(None, (AXIS_DATA, AXIS_FSDP))
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis_name), batch_spec),
-        out_specs=batch_spec,
+        in_specs=(P(axis_name), batch_spec, batch_spec),
+        out_specs=(P(), P(axis_name)),
         check_vma=False,
-    )(stage_params, microbatches)
+    )(stage_params, microbatches, targets)
 
 
 def stack_stage_params(per_layer_params, n_stages: int):

@@ -55,9 +55,93 @@ def logdir() -> str:
 
 def write_hparams(hparams: Dict[str, Any], logdir: Optional[str] = None) -> None:
     """Persist the trial's hyperparameters (reference tensorboard.py:104-107).
-    Goes through the Env abstraction so GCS experiment dirs work too."""
+    Goes through the Env abstraction so GCS experiment dirs work too. When the
+    tensorboard package is available, additionally writes the HParams-plugin
+    session-start summary so the trial shows as a session in the dashboard."""
     d = logdir or globals()["logdir"]()
     _env().dump(hparams, os.path.join(d, "hparams.json"))
+    try:
+        from tensorboard.plugins.hparams import summary as hparams_summary
+
+        clean = {
+            k: v if isinstance(v, (bool, int, float, str)) else str(v)
+            for k, v in hparams.items()
+        }
+        _write_tb_summary(d, hparams_summary.session_start_pb(hparams=clean))
+    except Exception:  # tensorboard absent / proto mismatch — json remains
+        pass
+
+
+def write_hparams_config(
+    log_dir: str, searchspace, metrics=("metric",)
+) -> bool:
+    """Write the HParams plugin *experiment* config from a Searchspace so the
+    TB HParams dashboard shows typed columns (reference tensorboard.py:47-102
+    via tf.summary/hp.hparams_config; this is a pure-proto equivalent with no
+    TF execution dependency). Returns False when tensorboard is unavailable."""
+    try:
+        from google.protobuf import struct_pb2
+        from tensorboard.plugins.hparams import api_pb2
+        from tensorboard.plugins.hparams import summary as hparams_summary
+    except Exception:
+        return False
+
+    infos = []
+    for key, typ in searchspace.names().items():
+        vals = searchspace.get(key)
+        if typ in ("DOUBLE", "INTEGER"):  # the plugin has no integer interval
+            infos.append(
+                api_pb2.HParamInfo(
+                    name=key,
+                    type=api_pb2.DATA_TYPE_FLOAT64,
+                    domain_interval=api_pb2.Interval(
+                        min_value=float(vals[0]), max_value=float(vals[1])
+                    ),
+                )
+            )
+        else:  # DISCRETE / CATEGORICAL
+            domain = struct_pb2.ListValue()
+            for v in vals:
+                if isinstance(v, bool):
+                    domain.values.add(bool_value=v)
+                elif isinstance(v, (int, float)):
+                    domain.values.add(number_value=float(v))
+                else:
+                    domain.values.add(string_value=str(v))
+            dtype = (
+                api_pb2.DATA_TYPE_STRING
+                if any(isinstance(v, str) for v in vals)
+                else api_pb2.DATA_TYPE_FLOAT64
+            )
+            infos.append(
+                api_pb2.HParamInfo(name=key, type=dtype, domain_discrete=domain)
+            )
+    metric_infos = [
+        api_pb2.MetricInfo(name=api_pb2.MetricName(tag=m)) for m in metrics
+    ]
+    summ = hparams_summary.experiment_pb(
+        hparam_infos=infos, metric_infos=metric_infos
+    )
+    return _write_tb_summary(log_dir, summ)
+
+
+def _write_tb_summary(log_dir: str, summary) -> bool:
+    """Append one Summary proto to an event file in ``log_dir`` (pure
+    tensorboard writer — no TF session machinery)."""
+    try:
+        from tensorboard.compat.proto import event_pb2
+        from tensorboard.summary.writer.event_file_writer import EventFileWriter
+
+        writer = EventFileWriter(log_dir)
+        event = event_pb2.Event(wall_time=time.time())
+        # serialize/parse: tensorboard.compat may hand back TF's Summary class
+        # while event_pb2 is tensorboard's own — same wire format
+        event.summary.ParseFromString(summary.SerializeToString())
+        writer.add_event(event)
+        writer.close()
+        return True
+    except Exception:
+        return False
 
 
 def scalar(tag: str, value: float, step: int) -> None:

@@ -314,5 +314,5 @@ def _stream_batches(loader_ref, q, stop) -> None:
                 }
         epoch += 1
         if one_epoch:
-            q.put(None)
+            _emit(q, None, stop, loader_ref)  # non-pinning end-of-data put
             return

@@ -120,7 +120,15 @@ class Trial:
 
     @staticmethod
     def _id_of_normalized(params: Dict[str, Any]) -> str:
-        canonical = json.dumps(params, sort_keys=True)
+        try:
+            canonical = json.dumps(params, sort_keys=True)
+        except TypeError as e:
+            # json.dumps raises an opaque '<' comparison error on mixed-type
+            # keys (the reference crashes identically; we just say why)
+            raise TypeError(
+                f"Trial params must not mix key types within one dict "
+                f"(json.dumps sort_keys cannot order them): {params!r}"
+            ) from e
         return hashlib.md5(canonical.encode("utf-8")).hexdigest()[:16]
 
     # ------------------------------------------------------------------ lifecycle

@@ -107,6 +107,55 @@ def test_busy_imputation_training_set():
     np.testing.assert_allclose(y[-2:], y[:4].mean())
 
 
+def test_asy_ts_beats_random_on_smooth_objective():
+    """Thompson sampling converges on a smooth objective at least as well as
+    random search (reference gp.py:158-162 asy_ts strategy)."""
+
+    def oracle(p):  # max at (0.7, 0.3)
+        return -((p["x"] - 0.7) ** 2) - (p["y"] - 0.3) ** 2
+
+    budget = 40
+    ts = GP(seed=0, acq_fun="asy_ts", num_warmup_trials=10)
+    ts_best = max(t.final_metric for t in drive(ts, oracle, num=budget))
+
+    rnd = get_optimizer("randomsearch", seed=0)
+    rnd_best = max(t.final_metric for t in drive(rnd, oracle, num=budget))
+    assert ts_best >= rnd_best - 1e-3, (ts_best, rnd_best)
+    assert ts_best > -0.02
+
+
+def test_kriging_believer_imputes_posterior_mean():
+    """imputation='kb': busy configs get the believer GP's mean at their
+    location, not a constant — near an observed point the imputed value is
+    close to that observation, and distinct busy points differ."""
+    gp = GP(seed=0, imputation="kb")
+    gp.setup(space(), 10, {}, [], direction="max")
+    for i in range(6):
+        t = gp.create_trial({"x": 0.15 * i, "y": 0.5})
+        t.finalize(float(i))
+        gp.final_store.append(t)
+    # one busy trial right on top of the best observation, one far away
+    near = gp.create_trial({"x": 0.75, "y": 0.5})
+    far = gp.create_trial({"x": 0.02, "y": 0.98})
+    gp.trial_store[near.trial_id] = near
+    gp.trial_store[far.trial_id] = far
+    X, y = gp._training_set()
+    assert X.shape == (8, 2)
+    # rows follow trial_store (insertion) order; metrics negated (direction max)
+    vals = y[-2:]
+    assert abs(vals[0] - (-5.0)) < 1.0, vals  # near x=0.75 -> ~best metric 5
+    assert abs(vals[0] - vals[1]) > 0.5, vals  # believer varies over space
+
+
+def test_kb_converges():
+    def oracle(p):
+        return -((p["x"] - 0.4) ** 2) - (p["y"] - 0.6) ** 2
+
+    gp = GP(seed=1, imputation="kb", num_warmup_trials=8)
+    best = max(t.final_metric for t in drive(gp, oracle, num=30))
+    assert best > -0.05
+
+
 @pytest.mark.parametrize("name", ["gp", "tpe"])
 def test_multi_fidelity_augment_with_hyperband(name, tmp_env):
     """Single [x, budget]-augmented surrogate drives a hyperband run e2e."""

@@ -47,6 +47,66 @@ def test_logdir_and_scalars_inside_lagom(tmp_env):
         tb.logdir()
 
 
+def test_hparams_plugin_config_readable(tmp_path):
+    """write_hparams_config emits an event the TB HParams plugin itself can
+    parse — typed columns for every searchspace dimension (the reference's
+    hp.hparams_config parity, tensorboard.py:47-102)."""
+    tb_mod = pytest.importorskip("tensorboard")
+    import glob
+
+    from maggy_tpu import tensorboard as tb
+
+    sp = Searchspace(
+        x=("DOUBLE", [0.0, 1.0]),
+        n=("INTEGER", [2, 8]),
+        act=("CATEGORICAL", ["relu", "gelu"]),
+    )
+    assert tb.write_hparams_config(str(tmp_path), sp)
+
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader,
+    )
+    from tensorboard.plugins.hparams import metadata, plugin_data_pb2
+
+    exp = None
+    for f in glob.glob(str(tmp_path / "events*")):
+        for ev in EventFileLoader(f).Load():
+            for v in ev.summary.value:
+                if v.tag == metadata.EXPERIMENT_TAG:
+                    pd = plugin_data_pb2.HParamsPluginData.FromString(
+                        v.metadata.plugin_data.content
+                    )
+                    exp = pd.experiment
+    assert exp is not None
+    assert sorted(h.name for h in exp.hparam_infos) == ["act", "n", "x"]
+    assert [m.name.tag for m in exp.metric_infos] == ["metric"]
+
+
+def test_hparams_session_start_written(tmp_path):
+    pytest.importorskip("tensorboard")
+    import glob
+
+    from maggy_tpu import tensorboard as tb
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader,
+    )
+    from tensorboard.plugins.hparams import metadata, plugin_data_pb2
+
+    tb.write_hparams({"x": 0.25, "act": "gelu"}, logdir=str(tmp_path))
+    got = None
+    for f in glob.glob(str(tmp_path / "events*")):
+        for ev in EventFileLoader(f).Load():
+            for v in ev.summary.value:
+                if v.tag == metadata.SESSION_START_INFO_TAG:
+                    pd = plugin_data_pb2.HParamsPluginData.FromString(
+                        v.metadata.plugin_data.content
+                    )
+                    got = pd.session_start_info.hparams
+    assert got is not None
+    assert got["x"].number_value == 0.25
+    assert got["act"].string_value == "gelu"
+
+
 def test_reporter_callback():
     r = Reporter()
     cb = ReporterCallback(r, metric="loss", negate=True, every=2)
