@@ -161,13 +161,18 @@ class GP(BaseAsyncBO):
         mu, _ = model.predict(Xs)
         Ks = model.amp2 * _matern52(Xs, model.X, model.lengthscales)
         v = np.linalg.solve(model.L, Ks.T)
-        cov = (
-            model.amp2 * _matern52(Xs, Xs, model.lengthscales)
-            - v.T @ v
-            + 1e-8 * np.eye(len(Xs))
-        )
-        Lp = np.linalg.cholesky(cov)
-        return mu + (Lp @ self.rng.standard_normal(len(Xs))) * model.y_std
+        cov = model.amp2 * _matern52(Xs, Xs, model.lengthscales) - v.T @ v
+        jitter = 1e-8 * max(model.amp2, 1.0)
+        for _ in range(3):  # roundoff can defeat a fixed jitter at large amp2
+            try:
+                Lp = np.linalg.cholesky(cov + jitter * np.eye(len(Xs)))
+                return mu + (Lp @ self.rng.standard_normal(len(Xs))) * model.y_std
+            except np.linalg.LinAlgError:
+                jitter *= 1e3
+        # joint draw unsalvageable: independent marginal draws still rank
+        # candidates usefully and never crash the suggestion loop
+        mu, sigma = model.predict(Xs)
+        return mu + sigma * self.rng.standard_normal(len(Xs))
 
     def _impute_busy(self, X_done, y_done, X_busy) -> np.ndarray:
         if self.imputation != "kb":

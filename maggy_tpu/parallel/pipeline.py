@@ -225,8 +225,11 @@ def pipeline_grads_1f1b(
                 xbuf,
             )
 
-            # 2. forward op (at most one per tick)
+            # 2. forward op (at most one per tick); the last stage's forward
+            # output has no consumer (no fwd_perm edge out, and its backward
+            # recomputes inside the vjp), so skip it there
             m_f, do_f = fwd_micro(t, stage)
+            do_f = do_f & ~is_last
             mf = jnp.clip(m_f, 0, M - 1)
             x_in = pick(xbuf, mf, mf % RING)
             y = jax.lax.cond(

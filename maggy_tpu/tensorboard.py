@@ -61,15 +61,50 @@ def write_hparams(hparams: Dict[str, Any], logdir: Optional[str] = None) -> None
     d = logdir or globals()["logdir"]()
     _env().dump(hparams, os.path.join(d, "hparams.json"))
     try:
-        from tensorboard.plugins.hparams import summary as hparams_summary
+        # NB: build the session-start proto by hand — the convenience module
+        # tensorboard.plugins.hparams.summary imports all of tensorflow (~8s),
+        # which would tax every experiment start; the raw protos are TF-free.
+        from tensorboard.plugins.hparams import metadata, plugin_data_pb2
 
-        clean = {
-            k: v if isinstance(v, (bool, int, float, str)) else str(v)
-            for k, v in hparams.items()
-        }
-        _write_tb_summary(d, hparams_summary.session_start_pb(hparams=clean))
+        info = plugin_data_pb2.SessionStartInfo(start_time_secs=time.time())
+        for k, v in hparams.items():
+            if isinstance(v, bool):
+                info.hparams[k].bool_value = v
+            elif isinstance(v, (int, float)):
+                info.hparams[k].number_value = float(v)
+            else:
+                info.hparams[k].string_value = str(v)
+        _write_tb_summary(
+            d,
+            _hparams_summary_pb(
+                metadata.SESSION_START_INFO_TAG, session_start_info=info
+            ),
+        )
     except Exception:  # tensorboard absent / proto mismatch — json remains
         pass
+
+
+def _hparams_summary_pb(tag: str, **plugin_fields):
+    """One-tag Summary carrying HParamsPluginData (what the plugin's
+    ``summary.experiment_pb``/``session_start_pb`` build, minus their
+    tensorflow import)."""
+    from tensorboard.compat.proto import summary_pb2
+    from tensorboard.plugins.hparams import metadata, plugin_data_pb2
+
+    data = plugin_data_pb2.HParamsPluginData(
+        version=metadata.PLUGIN_DATA_VERSION, **plugin_fields
+    )
+    summ = summary_pb2.Summary()
+    summ.value.add(
+        tag=tag,
+        metadata=summary_pb2.SummaryMetadata(
+            plugin_data=summary_pb2.SummaryMetadata.PluginData(
+                plugin_name=metadata.PLUGIN_NAME,
+                content=data.SerializeToString(),
+            )
+        ),
+    )
+    return summ
 
 
 def write_hparams_config(
@@ -81,8 +116,7 @@ def write_hparams_config(
     TF execution dependency). Returns False when tensorboard is unavailable."""
     try:
         from google.protobuf import struct_pb2
-        from tensorboard.plugins.hparams import api_pb2
-        from tensorboard.plugins.hparams import summary as hparams_summary
+        from tensorboard.plugins.hparams import api_pb2, metadata
     except Exception:
         return False
 
@@ -108,27 +142,50 @@ def write_hparams_config(
                     domain.values.add(number_value=float(v))
                 else:
                     domain.values.add(string_value=str(v))
-            dtype = (
-                api_pb2.DATA_TYPE_STRING
-                if any(isinstance(v, str) for v in vals)
-                else api_pb2.DATA_TYPE_FLOAT64
-            )
+            if any(isinstance(v, str) for v in vals):
+                dtype = api_pb2.DATA_TYPE_STRING
+            elif all(isinstance(v, bool) for v in vals):
+                dtype = api_pb2.DATA_TYPE_BOOL
+            else:
+                dtype = api_pb2.DATA_TYPE_FLOAT64
             infos.append(
                 api_pb2.HParamInfo(name=key, type=dtype, domain_discrete=domain)
             )
     metric_infos = [
         api_pb2.MetricInfo(name=api_pb2.MetricName(tag=m)) for m in metrics
     ]
-    summ = hparams_summary.experiment_pb(
-        hparam_infos=infos, metric_infos=metric_infos
+    experiment = api_pb2.Experiment(
+        hparam_infos=infos,
+        metric_infos=metric_infos,
+        time_created_secs=time.time(),
     )
+    summ = _hparams_summary_pb(metadata.EXPERIMENT_TAG, experiment=experiment)
     return _write_tb_summary(log_dir, summ)
+
+
+def _prefer_tb_stub(log_dir: str) -> None:
+    """Point tensorboard.compat's lazy ``tf`` at the pure-python stub unless
+    real TF is already loaded: EventFileWriter resolves ``tf.io.gfile`` through
+    it, and letting it import all of tensorflow costs ~8s at experiment start.
+    Remote dirs (gs:// etc.) keep the real-TF gfile, which knows those
+    filesystems — the stub does not."""
+    import sys
+    import types
+
+    if "://" in str(log_dir):
+        return
+    if "tensorflow" in sys.modules or "tensorboard.compat.notf" in sys.modules:
+        return
+    sys.modules["tensorboard.compat.notf"] = types.ModuleType(
+        "tensorboard.compat.notf"
+    )
 
 
 def _write_tb_summary(log_dir: str, summary) -> bool:
     """Append one Summary proto to an event file in ``log_dir`` (pure
     tensorboard writer — no TF session machinery)."""
     try:
+        _prefer_tb_stub(log_dir)
         from tensorboard.compat.proto import event_pb2
         from tensorboard.summary.writer.event_file_writer import EventFileWriter
 

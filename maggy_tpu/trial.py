@@ -125,10 +125,12 @@ class Trial:
         except TypeError as e:
             # json.dumps raises an opaque '<' comparison error on mixed-type
             # keys (the reference crashes identically; we just say why)
-            raise TypeError(
-                f"Trial params must not mix key types within one dict "
-                f"(json.dumps sort_keys cannot order them): {params!r}"
-            ) from e
+            if "not supported between instances" in str(e):
+                raise TypeError(
+                    f"Trial params must not mix key types within one dict "
+                    f"(json.dumps sort_keys cannot order them): {params!r}"
+                ) from e
+            raise
         return hashlib.md5(canonical.encode("utf-8")).hexdigest()[:16]
 
     # ------------------------------------------------------------------ lifecycle
