@@ -22,6 +22,13 @@ import os
 import sys
 import time
 
+# Every real-hardware run persists its numbers here; when the accelerator
+# tunnel is wedged at round end (it dies if any client is killed mid-compile)
+# the CPU-fallback record still carries the round's real measurement under
+# extra.last_real_tpu — labeled as such, never substituted for the headline.
+SNAPSHOT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_TPU_SNAPSHOT.json")
+
 
 def ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     """The TPU tunnel can wedge so that jax.devices() hangs forever; probe it
@@ -211,6 +218,18 @@ def main():
             "asha_wall_s": round(asha_stats["asha_wall_s"], 2),
         },
     }
+    if not train_stats["cpu_fallback"]:
+        try:
+            with open(SNAPSHOT_PATH, "w") as f:
+                json.dump({**out, "snapshot_time": time.time()}, f)
+        except OSError:
+            pass
+    else:
+        try:
+            with open(SNAPSHOT_PATH) as f:
+                out["extra"]["last_real_tpu"] = json.load(f)
+        except (OSError, ValueError):
+            pass
     print(json.dumps(out))
 
 

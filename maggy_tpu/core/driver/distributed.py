@@ -135,6 +135,13 @@ class DistributedTrainingDriver(Driver):
                     f"Distributed worker {msg['partition_id']} failed: {msg['error']}"
                 )
             with self.lock:
+                # a re-admitted (restarted) worker may FINAL twice for one
+                # partition — keep only its latest result
+                self._finals = [
+                    m
+                    for m in self._finals
+                    if m["partition_id"] != msg["partition_id"]
+                ]
                 self._finals.append(msg)
                 done = len(self._finals)
             self.log(f"Worker {msg['partition_id']} finished ({done}/{self.num_executors})")

@@ -20,7 +20,6 @@ import traceback
 from typing import Callable, Optional
 
 from maggy_tpu import util
-from maggy_tpu.core import rpc
 from maggy_tpu.core.env import EnvSing
 from maggy_tpu.exceptions import EarlyStopException
 from maggy_tpu.reporter import Reporter
@@ -43,7 +42,19 @@ def dist_executor_fn(
             log_file=os.path.join(exp_dir, f"executor_{partition_id}.log"),
             partition_id=partition_id,
         )
-        client = rpc.Client(server_addr, partition_id, secret, config.hb_interval)
+        # pod hosts start simultaneously: the driver may need many seconds of
+        # JAX bring-up before it listens, so retry well past Client's own 3
+        # attempts
+        from maggy_tpu.core.pod import _connect_with_deadline
+
+        client = _connect_with_deadline(
+            server_addr[0],
+            server_addr[1],
+            partition_id,
+            secret,
+            float(os.environ.get("MAGGY_TPU_CONNECT_TIMEOUT", "120")),
+            hb_interval=config.hb_interval,
+        )
         try:
             client.register(meta={"host": socket_mod.gethostname()})
             client.start_heartbeat(reporter)

@@ -71,6 +71,12 @@ def initialize_data_plane(
     jax.distributed.initialize(
         coordinator, num_processes=num_processes, process_id=process_id
     )
+    # Create the backend NOW: backend creation runs a global device-exchange
+    # barrier across all processes, so every rank must reach it at the same
+    # program point. Deferring it lets rank roles diverge — e.g. the driver
+    # touching jax before its RPC server is up while workers wait on that
+    # server before touching jax — a circular wait only broken by a timeout.
+    jax.devices()
     return True
 
 
@@ -135,17 +141,25 @@ def partition_id() -> int:
     return jax.process_index()
 
 
-def _connect_with_deadline(host: str, port: int, pid: int, secret: str, deadline_s: float):
+def _connect_with_deadline(
+    host: str,
+    port: int,
+    pid: int,
+    secret: str,
+    deadline_s: float,
+    hb_interval: Optional[float] = None,
+):
     """Pod hosts start simultaneously; the driver may need many seconds of JAX
     bring-up before it listens — retry well past Client's own 3 attempts."""
     from maggy_tpu.core import rpc
     from maggy_tpu.exceptions import RpcError
 
+    extra = () if hb_interval is None else (hb_interval,)
     deadline = time.time() + deadline_s
     delay = 0.2
     while True:
         try:
-            return rpc.Client((host, port), pid, secret)
+            return rpc.Client((host, port), pid, secret, *extra)
         except RpcError:
             if time.time() > deadline:
                 raise

@@ -60,9 +60,14 @@ def lagom(train_fn: Callable, config: LagomConfig) -> Any:
         worker_result = _maybe_run_as_pod_worker(train_fn, config)
         if worker_result is not None:
             return worker_result
+        import os
+
         if APP_ID is None:
-            APP_ID = util.new_app_id()
-        RUN_ID = util.RUNS.next_run_id(APP_ID)
+            # the elastic launcher pins app/run ids so every restart
+            # generation shares one experiment dir (and its checkpoints)
+            APP_ID = os.environ.get("MAGGY_TPU_APP_ID") or util.new_app_id()
+        run_id_env = os.environ.get("MAGGY_TPU_RUN_ID")
+        RUN_ID = int(run_id_env) if run_id_env else util.RUNS.next_run_id(APP_ID)
         driver = lagom_driver(config, APP_ID, RUN_ID)
         global CURRENT_DRIVER
         CURRENT_DRIVER = driver

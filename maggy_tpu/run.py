@@ -13,6 +13,16 @@ The script must pass ``num_executors=<workers>`` (or leave it to default to
 ``jax.process_count()``) and may use ``data_plane="local"`` for independent
 per-host replicas or initialize ``jax.distributed`` up front for one global
 mesh.
+
+Elastic training (``--elastic MAX_RESTARTS``): when any rank dies, the
+launcher tears the generation down and respawns every rank — the TPU-native
+recovery model, since a lost host wedges the surviving hosts' collectives
+exactly like a lost NCCL rank (the reference can only retry whole Spark
+tasks, rpc.py:415-437; slice-level restart is new here). App/run ids are
+pinned across generations so every generation lands in the same experiment
+directory, and training scripts resume from their latest checkpoint
+(``Checkpointer.latest_step`` + ``Trainer.fit(checkpointer=...)``). The
+generation number reaches scripts as ``MAGGY_TPU_GENERATION``.
 """
 
 from __future__ import annotations
@@ -23,12 +33,76 @@ import secrets
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _spawn_generation(args, base_env, generation: int):
+    """Start all ranks for one generation. Fresh driver/coordinator ports per
+    generation: the previous generation's sockets may linger in TIME_WAIT."""
+    port = _free_port()
+    env_gen = dict(base_env)
+    env_gen.update(
+        {
+            "MAGGY_TPU_DRIVER": f"{args.host}:{port}",
+            "MAGGY_TPU_GENERATION": str(generation),
+        }
+    )
+    if args.global_mesh:
+        env_gen["MAGGY_TPU_COORDINATOR"] = f"{args.host}:{_free_port()}"
+
+    procs = {}
+    for rank in range(args.workers):
+        env = dict(env_gen)
+        env["MAGGY_TPU_ROLE"] = "driver" if rank == 0 else "worker"
+        env["MAGGY_TPU_PARTITION"] = str(rank)
+        if rank == 0:
+            env["MAGGY_TPU_BIND_PORT"] = str(port)
+        stdout = stderr = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            stdout = open(
+                os.path.join(args.log_dir, f"rank{rank}.g{generation}.out"), "wb"
+            )
+            stderr = open(
+                os.path.join(args.log_dir, f"rank{rank}.g{generation}.err"), "wb"
+            )
+        procs[rank] = subprocess.Popen(
+            [sys.executable, args.script, *args.script_args],
+            env=env,
+            stdout=stdout,
+            stderr=stderr,
+        )
+        if stdout is not None:
+            stdout.close()
+            stderr.close()
+    return procs
+
+
+def _terminate_all(procs, grace: float = 5.0) -> None:
+    """SIGTERM then SIGKILL — ranks blocked in a wedged collective (their peer
+    just died) may never reach a Python signal handler."""
+    for proc in procs.values():
+        if proc.poll() is None:
+            proc.terminate()
+    deadline = time.time() + grace
+    for proc in procs.values():
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    for proc in procs.values():
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
 
 
 def main(argv=None) -> int:
@@ -43,72 +117,92 @@ def main(argv=None) -> int:
         "processes (the multi-host data plane); without it each process "
         "keeps a host-local backend",
     )
+    parser.add_argument(
+        "--elastic",
+        type=int,
+        default=0,
+        metavar="MAX_RESTARTS",
+        help="on any rank death, restart the whole generation (all ranks, "
+        "same experiment dir) up to MAX_RESTARTS times; scripts resume "
+        "from their latest checkpoint",
+    )
+    parser.add_argument(
+        "--log-dir",
+        default=None,
+        help="capture each rank's stdout/stderr to "
+        "LOG_DIR/rank<r>.g<generation>.{out,err} instead of inheriting "
+        "the launcher's streams",
+    )
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     if args.workers < 1:
         parser.error("--workers must be >= 1")
+    if args.elastic < 0:
+        parser.error("--elastic must be >= 0")
 
-    port = _free_port()
-    secret = secrets.token_hex(16)
     base_env = dict(os.environ)
     base_env.update(
         {
-            "MAGGY_TPU_DRIVER": f"{args.host}:{port}",
-            "MAGGY_TPU_SECRET": secret,
+            "MAGGY_TPU_SECRET": secrets.token_hex(16),
             "MAGGY_TPU_NUM_EXECUTORS": str(args.workers),
         }
     )
-    if args.global_mesh:
-        base_env["MAGGY_TPU_COORDINATOR"] = f"{args.host}:{_free_port()}"
-
-    procs = []
-    for rank in range(args.workers):
-        env = dict(base_env)
-        env["MAGGY_TPU_ROLE"] = "driver" if rank == 0 else "worker"
-        env["MAGGY_TPU_PARTITION"] = str(rank)
-        if rank == 0:
-            env["MAGGY_TPU_BIND_PORT"] = str(port)
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, args.script, *args.script_args], env=env
-            )
+    if args.elastic:
+        # every generation must land in the same experiment directory or
+        # checkpoints written by generation g are invisible to g+1
+        base_env.setdefault(
+            "MAGGY_TPU_APP_ID", f"application_{int(time.time())}_0001"
         )
+        base_env.setdefault("MAGGY_TPU_RUN_ID", "1")
 
+    generation = 0
+    procs = _spawn_generation(args, base_env, generation)
     exit_code = 0
     try:
-        remaining = dict(enumerate(procs))
+        remaining = dict(procs)
         while remaining:
-            import time
-
+            restart = failed = False
             for rank in list(remaining):
                 code = remaining[rank].poll()
                 if code is None:
                     continue
                 del remaining[rank]
-                if code != 0:
+                if code == 0:
+                    continue
+                if generation < args.elastic:
+                    print(
+                        f"[maggy_tpu.run] rank {rank} exited with {code}; "
+                        f"restarting generation {generation} -> {generation + 1} "
+                        f"({args.elastic - generation} restart(s) left)",
+                        file=sys.stderr,
+                    )
+                    restart = True
+                else:
+                    # fail fast: a dead driver would otherwise leave workers
+                    # spinning in their connect-retry window (and surviving
+                    # ranks of a global mesh wedged in collectives)
                     print(
                         f"[maggy_tpu.run] rank {rank} exited with {code}; "
                         "terminating remaining ranks",
                         file=sys.stderr,
                     )
                     exit_code = exit_code or code
-                    # fail fast: a dead driver would otherwise leave workers
-                    # spinning in their connect-retry window
-                    for other in remaining.values():
-                        other.terminate()
+                    failed = True
+                break
+            if failed:
+                break
+            if restart:
+                _terminate_all(procs)
+                generation += 1
+                procs = _spawn_generation(args, base_env, generation)
+                remaining = dict(procs)
+                continue
             time.sleep(0.1)
     except KeyboardInterrupt:
         exit_code = 130
     finally:
-        for proc in procs:
-            if proc.poll() is None:
-                proc.terminate()
-        for proc in procs:
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        _terminate_all(procs, grace=5.0)
     return exit_code
 
 

@@ -163,39 +163,77 @@ def write_hparams_config(
     return _write_tb_summary(log_dir, summ)
 
 
-def _prefer_tb_stub(log_dir: str) -> None:
-    """Point tensorboard.compat's lazy ``tf`` at the pure-python stub unless
-    real TF is already loaded: EventFileWriter resolves ``tf.io.gfile`` through
-    it, and letting it import all of tensorflow costs ~8s at experiment start.
-    Remote dirs (gs:// etc.) keep the real-TF gfile, which knows those
-    filesystems — the stub does not."""
-    import sys
-    import types
+# TFRecord framing for event files, first-party: tensorboard's own
+# EventFileWriter resolves its filesystem through tensorboard.compat.tf, which
+# imports all of tensorflow (~8s) when TF is installed — an unacceptable tax on
+# every experiment start, and forcing its pure-python stub instead would
+# repoint tensorboard.compat for the whole process. The format is four fields
+# per record: u64le length, masked crc32c(length), data, masked crc32c(data).
 
-    if "://" in str(log_dir):
-        return
-    if "tensorflow" in sys.modules or "tensorboard.compat.notf" in sys.modules:
-        return
-    sys.modules["tensorboard.compat.notf"] = types.ModuleType(
-        "tensorboard.compat.notf"
-    )
+_CRC32C_TABLE = None
+_event_file_seq = 0
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), table-driven; records here are tens of bytes."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> bytes:
+    import struct
+
+    crc = _crc32c(data)
+    masked = ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+    return struct.pack("<I", masked)
+
+
+def _tfrecord(data: bytes) -> bytes:
+    import struct
+
+    length = struct.pack("<Q", len(data))
+    return length + _masked_crc(length) + data + _masked_crc(data)
 
 
 def _write_tb_summary(log_dir: str, summary) -> bool:
-    """Append one Summary proto to an event file in ``log_dir`` (pure
-    tensorboard writer — no TF session machinery)."""
+    """Append one Summary proto to an event file in ``log_dir``. Goes through
+    the Env seam, so remote (gs://) experiment dirs work without tensorflow."""
     try:
-        _prefer_tb_stub(log_dir)
-        from tensorboard.compat.proto import event_pb2
-        from tensorboard.summary.writer.event_file_writer import EventFileWriter
+        import socket
 
-        writer = EventFileWriter(log_dir)
+        from tensorboard.compat.proto import event_pb2
+
         event = event_pb2.Event(wall_time=time.time())
-        # serialize/parse: tensorboard.compat may hand back TF's Summary class
-        # while event_pb2 is tensorboard's own — same wire format
+        # the hparams protos may come from TF's descriptor pool; same wire
+        # format, so serialize/parse across
         event.summary.ParseFromString(summary.SerializeToString())
-        writer.add_event(event)
-        writer.close()
+        version = event_pb2.Event(
+            wall_time=time.time(), file_version="brain.Event:2"
+        )
+        global _event_file_seq
+        _event_file_seq += 1
+        path = os.path.join(
+            log_dir,
+            "events.out.tfevents.{:.6f}.{}.{}.{}.mt".format(
+                time.time(), socket.gethostname(), os.getpid(), _event_file_seq
+            ),
+        )
+        env = _env()
+        env.mkdir(log_dir)
+        with env.open_file(path, "wb") as f:
+            f.write(_tfrecord(version.SerializeToString()))
+            f.write(_tfrecord(event.SerializeToString()))
         return True
     except Exception:
         return False

@@ -186,3 +186,111 @@ def test_run_launcher_arg_validation():
     )
     assert proc.returncode != 0
     assert "--workers" in proc.stderr
+
+
+ELASTIC_SCRIPT = textwrap.dedent(
+    """
+    import os, signal, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import maggy_tpu
+    assert maggy_tpu.initialize_data_plane()
+
+    import optax
+    from maggy_tpu import experiment
+    from maggy_tpu.config import DistributedConfig
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.train.checkpoint import Checkpointer
+    from maggy_tpu.train.data import synthetic_lm_batches
+
+    CFG = DecoderConfig.tiny()
+    GEN = int(os.environ["MAGGY_TPU_GENERATION"])
+    RANK = int(os.environ["MAGGY_TPU_PARTITION"])
+    TOTAL = 24
+
+    def train(model, dataset, reporter, ctx):
+        trainer = ctx.trainer(model, optax.adamw(3e-3))
+        state = trainer.make_state(jax.random.key(0), next(dataset))
+        ckpt = Checkpointer(os.environ["MT_CKPT_DIR"], async_save=False)
+        start = ckpt.latest_step()
+        if start is not None:
+            state = ckpt.restore(state, step=start)
+            for _ in range(start):  # realign the deterministic batch stream
+                next(dataset)
+        else:
+            start = 0
+        with open(os.environ["MT_TRACE_FILE"] + f".g{{GEN}}.r{{RANK}}", "w") as f:
+            f.write(str(start))
+        last = None
+        for i in range(start, TOTAL):
+            state, m = trainer.step(state, trainer.shard_batch(next(dataset)))
+            last = float(m["loss"])
+            if (i + 1) % 4 == 0:
+                ckpt.save(i + 1, state)
+                ckpt.wait()
+            if GEN == 0 and RANK == 2 and i + 1 == 10:
+                os.kill(os.getpid(), signal.SIGKILL)  # simulated host loss
+        ckpt.close()
+        return {{"metric": last, "loss": last, "end_step": int(state.step)}}
+
+    result = experiment.lagom(
+        train,
+        DistributedConfig(
+            module=Decoder(CFG),
+            dataset=synthetic_lm_batches(CFG.vocab_size, 12, 32, seed=7),
+            sharding="dp",
+            data_plane="auto",
+            hb_interval=0.05,
+        ),
+    )
+    if jax.process_index() == 0:
+        import json
+        with open(os.environ["MT_RESULT_FILE"], "w") as f:
+            json.dump(result, f)
+    print("ELASTIC_OK", flush=True)
+    """
+).format(repo=REPO)
+
+
+def test_run_launcher_elastic_restart(tmp_path):
+    """Kill one of three global-mesh workers mid-run: the launcher restarts the
+    generation, the experiment dir is pinned, training resumes from the latest
+    checkpoint (not step 0), and the run still completes and converges."""
+    script = tmp_path / "elastic_script.py"
+    script.write_text(ELASTIC_SCRIPT)
+    result_file = tmp_path / "result.json"
+    trace = tmp_path / "trace"
+    env = dict(os.environ)
+    env["MAGGY_TPU_LOG_ROOT"] = str(tmp_path / "logs")
+    env["MT_RESULT_FILE"] = str(result_file)
+    env["MT_TRACE_FILE"] = str(trace)
+    env["MT_CKPT_DIR"] = str(tmp_path / "ckpt")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "maggy_tpu.run",
+            "--workers", "3", "--global-mesh", "--elastic", "2", str(script),
+        ],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-2500:])
+    assert "restarting generation 0 -> 1" in proc.stderr, proc.stderr[-2000:]
+
+    # generation 0 started cold, generation 1 resumed from a checkpoint
+    g0 = int((tmp_path / "trace.g0.r0").read_text())
+    g1 = int((tmp_path / "trace.g1.r0").read_text())
+    assert g0 == 0
+    assert 0 < g1 < 24, g1
+
+    import json
+
+    result = json.load(result_file.open())
+    assert result["num_workers"] == 3
+    assert result["end_step"] == 24.0
