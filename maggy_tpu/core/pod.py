@@ -147,19 +147,18 @@ def _connect_with_deadline(
     pid: int,
     secret: str,
     deadline_s: float,
-    hb_interval: Optional[float] = None,
+    hb_interval: float = 1.0,  # rpc.Client's own default
 ):
     """Pod hosts start simultaneously; the driver may need many seconds of JAX
     bring-up before it listens — retry well past Client's own 3 attempts."""
     from maggy_tpu.core import rpc
     from maggy_tpu.exceptions import RpcError
 
-    extra = () if hb_interval is None else (hb_interval,)
     deadline = time.time() + deadline_s
     delay = 0.2
     while True:
         try:
-            return rpc.Client((host, port), pid, secret, *extra)
+            return rpc.Client((host, port), pid, secret, hb_interval)
         except RpcError:
             if time.time() > deadline:
                 raise

@@ -29,6 +29,7 @@ APP_ID: Optional[str] = None
 RUN_ID: int = 0
 _running_lock = threading.Lock()
 _running = False
+_env_run_id_used = False
 # the driver currently executing (monitoring/launcher introspection)
 CURRENT_DRIVER = None
 
@@ -62,12 +63,20 @@ def lagom(train_fn: Callable, config: LagomConfig) -> Any:
             return worker_result
         import os
 
+        global _env_run_id_used
         if APP_ID is None:
             # the elastic launcher pins app/run ids so every restart
             # generation shares one experiment dir (and its checkpoints)
             APP_ID = os.environ.get("MAGGY_TPU_APP_ID") or util.new_app_id()
         run_id_env = os.environ.get("MAGGY_TPU_RUN_ID")
-        RUN_ID = int(run_id_env) if run_id_env else util.RUNS.next_run_id(APP_ID)
+        if run_id_env and not _env_run_id_used:
+            # the pin applies to the process's FIRST experiment only; later
+            # lagom() calls in the same script get fresh run dirs after it
+            _env_run_id_used = True
+            RUN_ID = int(run_id_env)
+            util.RUNS.observe(APP_ID, RUN_ID)
+        else:
+            RUN_ID = util.RUNS.next_run_id(APP_ID)
         driver = lagom_driver(config, APP_ID, RUN_ID)
         global CURRENT_DRIVER
         CURRENT_DRIVER = driver

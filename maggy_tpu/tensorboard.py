@@ -171,7 +171,6 @@ def write_hparams_config(
 # per record: u64le length, masked crc32c(length), data, masked crc32c(data).
 
 _CRC32C_TABLE = None
-_event_file_seq = 0
 
 
 def _crc32c(data: bytes) -> int:
@@ -221,12 +220,14 @@ def _write_tb_summary(log_dir: str, summary) -> bool:
         version = event_pb2.Event(
             wall_time=time.time(), file_version="brain.Event:2"
         )
-        global _event_file_seq
-        _event_file_seq += 1
+        import uuid
+
+        # unique per call with no shared counter: executors are threads in one
+        # process, and a racy counter + same-microsecond clock could collide
         path = os.path.join(
             log_dir,
-            "events.out.tfevents.{:.6f}.{}.{}.{}.mt".format(
-                time.time(), socket.gethostname(), os.getpid(), _event_file_seq
+            "events.out.tfevents.{:.6f}.{}.{}.mt".format(
+                time.time(), socket.gethostname(), uuid.uuid4().hex[:8]
             ),
         )
         env = _env()

@@ -204,5 +204,10 @@ class RunRegistry:
         self._run_ids[app_id] = rid
         return rid
 
+    def observe(self, app_id: str, run_id: int) -> None:
+        """Record an externally-assigned run id (env-pinned by the elastic
+        launcher) so later next_run_id calls continue after it."""
+        self._run_ids[app_id] = max(self._run_ids.get(app_id, 0), int(run_id))
+
 
 RUNS = RunRegistry()
