@@ -1,0 +1,397 @@
+"""Pallas ring attention: KV rotation via explicit inter-chip RDMA.
+
+The shard_map ring in :mod:`maggy_tpu.parallel.ringattention` leaves the
+KV rotation to XLA's ``ppermute`` scheduling. This kernel issues the rotation
+itself with ``pltpu.make_async_remote_copy`` and overlaps it with the block
+compute explicitly: at ring step ``s`` each device STARTS the RDMA of its
+current KV chunk to its right neighbor, computes online-softmax attention on
+that same chunk while the copy is in flight, then acknowledges consumption so
+the left neighbor may overwrite the just-freed slot (2-slot double buffer with
+per-cell flow control — no global lockstep).
+
+Memory plan (VMEM is ~16MB/core): q/o and the f32 accumulators live in HBM
+(``pltpu.ANY``); the kernel stages one q row-tile and one KV chunk at a time
+into VMEM scratch. Communication buffers are per-(batch, kv-head) HBM slots so
+grid cells may skew across devices without clobbering each other. Causal runs
+skip fully-masked chunks (the compute, not the rotation).
+
+No equivalent exists in the reference (SURVEY.md §5.7 — sequence parallelism
+is absent there); the layout matches ``parallel/ringattention.py`` so the two
+implementations are interchangeable and cross-checked in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _neighbor(mesh, axis_name: str, offset: int):
+    """Mesh coordinates of the ring neighbor at ``offset`` along ``axis_name``
+    (same pattern as pallas's reference all-gather kernel)."""
+    idx = lax.axis_index(axis_name)
+    size = lax.axis_size(axis_name)
+    nxt = lax.rem(idx + offset + size, size)
+    return tuple(
+        nxt if name == axis_name else lax.axis_index(name)
+        for name in mesh.axis_names
+    )
+
+
+def _ring_kernel(
+    q_ref,       # ANY [B, C, KH, G, D]
+    k_ref,       # ANY [B, C, KH, D]
+    v_ref,       # ANY [B, C, KH, D]
+    o_ref,       # ANY [B, C, KH, G, D]
+    kbuf,        # ANY [B, KH, 2, C, D]   ring comm buffer (k)
+    vbuf,        # ANY [B, KH, 2, C, D]   ring comm buffer (v)
+    acc_ref,     # ANY [B, C, KH, G, D] f32
+    m_ref,       # ANY [B, C, KH, G] f32
+    l_ref,       # ANY [B, C, KH, G] f32
+    q_st,        # VMEM [QT, G, D]
+    k_st,        # VMEM [C, D]
+    v_st,        # VMEM [C, D]
+    acc_st,      # VMEM [QT, G, D] f32
+    ml_st,       # VMEM [2, QT, G] f32   (m, l)
+    send_k,      # DMA sems [B, KH]
+    send_v,
+    recv_k,      # DMA sems [B, KH, 2]
+    recv_v,
+    ack,         # REGULAR sems [B, KH]
+    copy_sem,    # DMA sems [8] for local HBM<->VMEM staging
+    *,
+    mesh,
+    axis_name: str,
+    num_shards: int,
+    causal: bool,
+    q_tile: int,
+):
+    b = pl.program_id(0)
+    kh = pl.program_id(1)
+    C = k_st.shape[0]
+    G = q_st.shape[1]
+    n_qt = C // q_tile
+    my = lax.axis_index(axis_name)
+    left = _neighbor(mesh, axis_name, -1)
+    right = _neighbor(mesh, axis_name, +1)
+    scale = 1.0 / (q_st.shape[2] ** 0.5)
+
+    # one barrier per kernel launch: neighbors must have entered the kernel
+    # (buffers out of their previous op's live ranges) before any RDMA lands
+    @pl.when((b == 0) & (kh == 0))
+    def _startup_barrier():
+        bar = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bar, 1, device_id=left)
+        pltpu.semaphore_signal(bar, 1, device_id=right)
+        pltpu.semaphore_wait(bar, 2)
+
+    def _stage_kv(step):
+        """current chunk -> VMEM (step 0 reads the local input directly)."""
+        cur = lax.rem(step, 2)
+
+        @pl.when(step == 0)
+        def _():
+            cp_k = pltpu.make_async_copy(
+                k_ref.at[b, :, kh, :], k_st, copy_sem.at[0]
+            )
+            cp_v = pltpu.make_async_copy(
+                v_ref.at[b, :, kh, :], v_st, copy_sem.at[1]
+            )
+            cp_k.start(); cp_v.start(); cp_k.wait(); cp_v.wait()
+
+        @pl.when(step > 0)
+        def _():
+            cp_k = pltpu.make_async_copy(kbuf.at[b, kh, cur], k_st, copy_sem.at[0])
+            cp_v = pltpu.make_async_copy(vbuf.at[b, kh, cur], v_st, copy_sem.at[1])
+            cp_k.start(); cp_v.start(); cp_k.wait(); cp_v.wait()
+
+    def _compute_chunk(step):
+        """Online-softmax update of every q row-tile against the staged KV
+        chunk; runs while this step's RDMA is in flight."""
+        src = lax.rem(my - step + num_shards, num_shards)  # owner of the chunk
+        k_pos = src * C + lax.broadcasted_iota(jnp.int32, (1, 1, C), 2)
+
+        def tile_body(qt, _):
+            row0 = qt * q_tile
+            cp_q = pltpu.make_async_copy(
+                q_ref.at[b, pl.ds(row0, q_tile), kh], q_st, copy_sem.at[2]
+            )
+            cp_q.start()
+
+            @pl.when(step == 0)
+            def _():
+                acc_st[...] = jnp.zeros_like(acc_st)
+                ml_st[0] = jnp.full_like(ml_st[0], NEG_INF)
+                ml_st[1] = jnp.zeros_like(ml_st[1])
+
+            @pl.when(step > 0)
+            def _():
+                cp_a = pltpu.make_async_copy(
+                    acc_ref.at[b, pl.ds(row0, q_tile), kh], acc_st, copy_sem.at[3]
+                )
+                cp_m = pltpu.make_async_copy(
+                    m_ref.at[b, pl.ds(row0, q_tile), kh], ml_st.at[0], copy_sem.at[4]
+                )
+                cp_l = pltpu.make_async_copy(
+                    l_ref.at[b, pl.ds(row0, q_tile), kh], ml_st.at[1], copy_sem.at[5]
+                )
+                cp_a.start(); cp_m.start(); cp_l.start()
+                cp_a.wait(); cp_m.wait(); cp_l.wait()
+
+            cp_q.wait()
+
+            q = q_st[...].astype(jnp.float32)          # [QT, G, D]
+            k = k_st[...].astype(jnp.float32)          # [C, D]
+            logits = jax.lax.dot_general(
+                q.reshape(q_tile * G, -1), k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).reshape(q_tile, G, C) * scale            # [QT, G, C]
+            if causal:
+                q_pos = (
+                    my * C + row0
+                    + lax.broadcasted_iota(jnp.int32, (q_tile, 1, 1), 0)
+                )
+                logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+
+            m_prev = ml_st[0]                          # [QT, G]
+            l_prev = ml_st[1]
+            m_new = jnp.maximum(m_prev, logits.max(axis=-1))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(logits - m_new[..., None])     # [QT, G, C]
+            l_new = l_prev * alpha + p.sum(axis=-1)
+            pv = jax.lax.dot_general(
+                p.reshape(q_tile * G, C), v_st[...].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).reshape(q_tile, G, -1)
+            acc_st[...] = acc_st[...] * alpha[..., None] + pv
+            ml_st[0] = m_new
+            ml_st[1] = l_new
+
+            # persist accumulators for the next ring step
+            cp_a = pltpu.make_async_copy(
+                acc_st, acc_ref.at[b, pl.ds(row0, q_tile), kh], copy_sem.at[3]
+            )
+            cp_m = pltpu.make_async_copy(
+                ml_st.at[0], m_ref.at[b, pl.ds(row0, q_tile), kh], copy_sem.at[4]
+            )
+            cp_l = pltpu.make_async_copy(
+                ml_st.at[1], l_ref.at[b, pl.ds(row0, q_tile), kh], copy_sem.at[5]
+            )
+            cp_a.start(); cp_m.start(); cp_l.start()
+            cp_a.wait(); cp_m.wait(); cp_l.wait()
+            return 0
+
+        lax.fori_loop(0, n_qt, tile_body, 0)
+
+    def _rdma_desc(s, buf, s_sem, r_sem):
+        """The descriptor of the RDMA started at step ``s`` — every device
+        runs the same program, so waiting on OUR descriptor's recv side waits
+        for the LEFT neighbor's symmetric send to land (the same SPMD idiom as
+        pallas's reference all-gather kernel)."""
+        src = lax.rem(s, 2)
+        dst = lax.rem(s + 1, 2)
+        return pltpu.make_async_remote_copy(
+            buf.at[b, kh, src], buf.at[b, kh, dst],
+            s_sem.at[b, kh], r_sem.at[b, kh, dst],
+            device_id=right,
+        )
+
+    def step_body(s, _):
+        cur = lax.rem(s, 2)
+        nxt = lax.rem(s + 1, 2)
+
+        # chunk s arrived? (step 0 computes on the local input)
+        @pl.when(s > 0)
+        def _():
+            _rdma_desc(s - 1, kbuf, send_k, recv_k).wait_recv()
+            _rdma_desc(s - 1, vbuf, send_v, recv_v).wait_recv()
+
+        _stage_kv(s)
+
+        # rotate: start sending the chunk we hold, then compute on it
+        @pl.when(s < num_shards - 1)
+        def _():
+            # flow control: right must have consumed its `nxt` slot (its
+            # compute of step s-1); its ack arrives on OUR ack sem
+            @pl.when(s > 0)
+            def _():
+                pltpu.semaphore_wait(ack.at[b, kh], 1)
+
+            def _send(src_first, src_later, buf, s_sem, r_sem):
+                @pl.when(s == 0)
+                def _():
+                    pltpu.make_async_remote_copy(
+                        src_first, buf.at[b, kh, nxt],
+                        s_sem.at[b, kh], r_sem.at[b, kh, nxt],
+                        device_id=right,
+                    ).start()
+
+                @pl.when(s > 0)
+                def _():
+                    pltpu.make_async_remote_copy(
+                        src_later, buf.at[b, kh, nxt],
+                        s_sem.at[b, kh], r_sem.at[b, kh, nxt],
+                        device_id=right,
+                    ).start()
+
+            _send(k_ref.at[b, :, kh, :], kbuf.at[b, kh, cur], kbuf, send_k, recv_k)
+            _send(v_ref.at[b, :, kh, :], vbuf.at[b, kh, cur], vbuf, send_v, recv_v)
+
+        # the overlapped work: attention on the chunk while RDMA flies
+        src = lax.rem(my - s + num_shards, num_shards)
+        skip = causal & (src > my)  # chunk entirely in the causal future
+
+        @pl.when(jnp.logical_not(skip))
+        def _():
+            _compute_chunk(s)
+
+        @pl.when(s < num_shards - 1)
+        def _():
+            # outgoing copy must have left our buffer before the left
+            # neighbor is allowed to overwrite it (our ack)
+            _rdma_desc(s, kbuf, send_k, recv_k).wait_send()
+            _rdma_desc(s, vbuf, send_v, recv_v).wait_send()
+
+        # acks consumed at steps 1..N-2 by the left's sender — produce exactly
+        # that many (a leftover count would fail the kernel's sem-drain check)
+        @pl.when(s < num_shards - 2)
+        def _():
+            pltpu.semaphore_signal(ack.at[b, kh], 1, device_id=left)
+
+        return 0
+
+    lax.fori_loop(0, num_shards, step_body, 0)
+
+    # finalize: o = acc / l
+    def out_tile(qt, _):
+        row0 = qt * q_tile
+        cp_a = pltpu.make_async_copy(
+            acc_ref.at[b, pl.ds(row0, q_tile), kh], acc_st, copy_sem.at[3]
+        )
+        cp_l = pltpu.make_async_copy(
+            l_ref.at[b, pl.ds(row0, q_tile), kh], ml_st.at[1], copy_sem.at[5]
+        )
+        cp_a.start(); cp_l.start(); cp_a.wait(); cp_l.wait()
+        l = jnp.maximum(ml_st[1], 1e-30)[..., None]
+        q_st[...] = (acc_st[...] / l).astype(q_st.dtype)  # reuse q staging
+        cp_o = pltpu.make_async_copy(
+            q_st, o_ref.at[b, pl.ds(row0, q_tile), kh], copy_sem.at[6]
+        )
+        cp_o.start(); cp_o.wait()
+        return 0
+
+    lax.fori_loop(0, n_qt, out_tile, 0)
+
+
+def _ring_flash_local(q, k, v, *, mesh, axis_name, num_shards, causal,
+                      q_tile, interpret):
+    """Per-device body (under shard_map): q [B, C, H, D], k/v [B, C, KH, D]."""
+    B, C, H, D = q.shape
+    KH = k.shape[2]
+    G = H // KH
+    qg = q.reshape(B, C, KH, G, D)
+
+    kernel = functools.partial(
+        _ring_kernel,
+        mesh=mesh,
+        axis_name=axis_name,
+        num_shards=num_shards,
+        causal=causal,
+        q_tile=q_tile,
+    )
+    f32 = jnp.float32
+    out_shapes = (
+        jax.ShapeDtypeStruct((B, C, KH, G, D), q.dtype),   # o
+        jax.ShapeDtypeStruct((B, KH, 2, C, D), k.dtype),   # kbuf
+        jax.ShapeDtypeStruct((B, KH, 2, C, D), v.dtype),   # vbuf
+        jax.ShapeDtypeStruct((B, C, KH, G, D), f32),       # acc
+        jax.ShapeDtypeStruct((B, C, KH, G), f32),          # m
+        jax.ShapeDtypeStruct((B, C, KH, G), f32),          # l
+    )
+    any_spec = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
+    o = pl.pallas_call(
+        kernel,
+        grid=(B, KH),
+        in_specs=[any_spec] * 3,
+        out_specs=[any_spec] * 6,
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((q_tile, G, D), q.dtype),       # q_st
+            pltpu.VMEM((C, D), k.dtype),               # k_st
+            pltpu.VMEM((C, D), v.dtype),               # v_st
+            pltpu.VMEM((q_tile, G, D), f32),           # acc_st
+            pltpu.VMEM((2, q_tile, G), f32),           # ml_st
+            pltpu.SemaphoreType.DMA((B, KH)),          # send_k
+            pltpu.SemaphoreType.DMA((B, KH)),          # send_v
+            pltpu.SemaphoreType.DMA((B, KH, 2)),       # recv_k
+            pltpu.SemaphoreType.DMA((B, KH, 2)),       # recv_v
+            pltpu.SemaphoreType.REGULAR((B, KH)),      # ack
+            pltpu.SemaphoreType.DMA((8,)),             # local staging sems
+        ],
+        compiler_params=pltpu.CompilerParams(
+            collective_id=7, has_side_effects=True
+        ),
+        interpret=(
+            pltpu.InterpretParams() if interpret else False
+        ),
+    )(qg, k, v)[0]
+    return o.reshape(B, C, H, D)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    causal: bool = True,
+    axis_name: str = "seq",
+    q_tile: int = 256,
+    interpret: bool = False,
+):
+    """Ring attention with in-kernel RDMA rotation (forward).
+
+    :param q: [B, S, H, D] sharded on S over ``axis_name``; k/v [B, S, KH, D].
+    :param q_tile: VMEM row-tile; the per-device chunk must divide by it.
+    :param interpret: run under the TPU interpret machine (CPU testing —
+        remote DMAs and semaphores are simulated faithfully).
+
+    Gradients: not defined by this kernel — training paths wrap it with
+    ``jax.custom_vjp`` falling back to the ppermute ring for the backward
+    (see :func:`maggy_tpu.parallel.ringattention.ring_attention`).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    num_shards = mesh.shape[axis_name]
+    if num_shards == 1:
+        from maggy_tpu.ops import attention as ops_attn
+
+        return ops_attn.blockwise_attention(q, k, v, causal=causal)
+    chunk = q.shape[1] // num_shards
+    tile = min(q_tile, chunk)
+    if chunk % tile:
+        raise ValueError(f"per-device chunk {chunk} not divisible by q_tile {tile}")
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(
+        _ring_flash_local,
+        mesh=mesh,
+        axis_name=axis_name,
+        num_shards=num_shards,
+        causal=causal,
+        q_tile=tile,
+        interpret=interpret,
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -78,18 +78,41 @@ def ring_attention(
     causal: bool = True,
     axis_name: str = AXIS_SEQ,
     segment_ids=None,
+    impl: str = "xla",
+    interpret=None,
 ):
     """Global-view ring attention: q [B,S,H,D], k/v [B,S,Kh,D] sharded on S.
 
     Call under ``jit`` with the mesh active; works as the Decoder's
     ``attention_fn`` when the sharding spec has ``sp > 1``.
+
+    :param impl: ``"xla"`` — the shard_map/ppermute ring (XLA schedules the
+        rotation; fully differentiable). ``"pallas"`` — the
+        :mod:`maggy_tpu.ops.ring_flash` kernel: the KV rotation is issued
+        in-kernel via ``make_async_remote_copy`` and explicitly overlapped
+        with the block compute. Its backward re-runs the XLA ring under
+        ``jax.vjp`` (recompute, the standard ring-attention trade).
+    :param interpret: pallas only — run under the TPU interpret machine
+        (defaults to True off-TPU so CPU meshes can test the kernel).
     """
     if segment_ids is not None:
         raise NotImplementedError("ring attention does not support segment_ids yet")
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
     num_shards = mesh.shape[axis_name]
     if num_shards == 1:
         return ops_attn.blockwise_attention(q, k, v, causal=causal)
 
+    if impl == "pallas":
+        return _pallas_ring(
+            q, k, v, mesh=mesh, causal=causal, axis_name=axis_name,
+            interpret=interpret,
+        )
+    return _xla_ring(q, k, v, mesh=mesh, causal=causal, axis_name=axis_name)
+
+
+def _xla_ring(q, k, v, *, mesh, causal, axis_name):
+    num_shards = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
     fn = functools.partial(
         _local_ring_attention,
@@ -106,14 +129,44 @@ def ring_attention(
     )(q, k, v)
 
 
-def make_ring_attention(mesh, axis_name: str = AXIS_SEQ):
+def _pallas_ring(q, k, v, *, mesh, causal, axis_name, interpret):
+    from maggy_tpu.ops.ring_flash import ring_flash_attention
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return ring_flash_attention(
+            q, k, v, mesh=mesh, causal=causal, axis_name=axis_name,
+            interpret=interpret,
+        )
+
+    def fwd(q, k, v):
+        return attn(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, pull = jax.vjp(
+            functools.partial(
+                _xla_ring, mesh=mesh, causal=causal, axis_name=axis_name
+            ),
+            q, k, v,
+        )
+        return pull(g)
+
+    attn.defvjp(fwd, bwd)
+    return attn(q, k, v)
+
+
+def make_ring_attention(mesh, axis_name: str = AXIS_SEQ, impl: str = "xla"):
     """Build an ``attention_fn`` for DecoderConfig: same signature as
     ``default_attention``."""
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
         return ring_attention(
             q, k, v, mesh=mesh, causal=causal, axis_name=axis_name,
-            segment_ids=segment_ids,
+            segment_ids=segment_ids, impl=impl,
         )
 
     return attn

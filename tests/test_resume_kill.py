@@ -78,8 +78,13 @@ def _persisted_params(exp_dir):
     for name in os.listdir(exp_dir):
         path = os.path.join(exp_dir, name, "trial.json")
         if os.path.exists(path):
-            with open(path) as f:
-                rec = json.load(f)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except json.JSONDecodeError:
+                # SIGKILL mid-write can truncate the newest record; the
+                # production loader tolerates this too (load_finalized_trials)
+                continue
             if rec.get("status") == "FINALIZED":
                 out.append(tuple(sorted(rec["params"].items())))
     return out
@@ -113,7 +118,8 @@ def test_resume_after_sigkill(tmp_path):
     )
     assert first.returncode == -9, (first.returncode, first.stderr[-1000:])
     persisted = _persisted_params(str(app_dir))
-    assert len(persisted) >= 6
+    # killer fired at 6 files on disk; the newest may be truncated mid-write
+    assert len(persisted) >= 5
     assert len(persisted) < 16, "crash came too late to exercise resume"
 
     # resume into a fresh run dir, same seed -> same suggestion stream

@@ -1,0 +1,75 @@
+"""Pallas ring attention (in-kernel RDMA rotation) vs the ppermute ring and
+the dense reference, on the CPU mesh via the TPU interpret machine (remote
+DMAs and semaphores are simulated faithfully; VERDICT r1 item 5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from maggy_tpu.models.transformer import default_attention
+from maggy_tpu.ops.ring_flash import ring_flash_attention
+from maggy_tpu.parallel.ringattention import ring_attention
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs the 8-device CPU mesh"
+)
+
+
+def _mesh(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+
+def _qkv(B=2, S=128, H=4, KH=2, D=16):
+    q = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(2), (B, S, KH, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(3), (B, S, KH, D), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_dense(causal):
+    mesh = _mesh(4)
+    q, k, v = _qkv()
+    ref = default_attention(q, k, v, causal=causal)
+    with jax.set_mesh(mesh):
+        out = ring_flash_attention(
+            q, k, v, mesh=mesh, causal=causal, q_tile=16, interpret=True
+        )
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+def test_ring_flash_gqa_matches_xla_ring():
+    """sp=4 mesh, grouped KV heads: the RDMA kernel and the ppermute ring are
+    the same computation distributed two different ways."""
+    mesh = _mesh(4)
+    q, k, v = _qkv(B=1, S=64, H=4, KH=1, D=8)
+    with jax.set_mesh(mesh):
+        xla = ring_attention(q, k, v, mesh=mesh, causal=True, impl="xla")
+        pallas = ring_attention(
+            q, k, v, mesh=mesh, causal=True, impl="pallas", interpret=True
+        )
+    assert float(jnp.abs(pallas - xla).max()) < 2e-5
+
+
+def test_ring_flash_backward_falls_to_xla_ring():
+    """The custom_vjp backward must give the same gradients as the XLA ring."""
+    mesh = _mesh(2)
+    q, k, v = _qkv(B=1, S=32, H=2, KH=2, D=8)
+
+    def loss_pallas(q, k, v):
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=True, impl="pallas", interpret=True
+        )
+        return (out**2).sum()
+
+    def loss_xla(q, k, v):
+        out = ring_attention(q, k, v, mesh=mesh, causal=True, impl="xla")
+        return (out**2).sum()
+
+    with jax.set_mesh(mesh):
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gx):
+        assert float(jnp.abs(a - b).max()) < 5e-5

@@ -96,7 +96,7 @@ def test_asha_256_trials_scale(tmp_env):
     X = jnp.array([[1.0, 0.5], [0.3, 2.0], [1.5, 1.0], [0.2, 0.8]])
     y = jnp.array([1.0, 2.0, 1.8, 0.9])
 
-    completions = []
+    completions = []  # budget of each trial, in completion order
     lock = threading.Lock()
 
     def train(hparams, budget, reporter):
@@ -104,7 +104,7 @@ def test_asha_256_trials_scale(tmp_env):
         loss = float(jnp.mean((X @ w - y) ** 2))
         reporter.broadcast(-loss, step=0)
         with lock:
-            completions.append(time.monotonic())
+            completions.append(int(budget))
         return -loss
 
     before_threads = threading.active_count()
@@ -126,7 +126,18 @@ def test_asha_256_trials_scale(tmp_env):
     # rung arithmetic at reduction factor 2: 256 + 128 + 64 + 32 + 16 + ...
     assert result["num_trials"] >= 256
     assert len(completions) == result["num_trials"]
-    assert completions == sorted(completions), "completion timestamps not monotone"
+    # ASHA promotion ordering: a rung-(r+1) trial is only *suggested* after
+    # reduction_factor times as many rung-r trials have finished, so at every
+    # prefix of the completion sequence n_r >= 2 * n_{r+1} must hold
+    budgets_seen = sorted(set(completions))
+    counts = {bgt: 0 for bgt in budgets_seen}
+    for bgt in completions:
+        counts[bgt] += 1
+        for lo, hi in zip(budgets_seen, budgets_seen[1:]):
+            assert counts[lo] >= 2 * counts[hi], (
+                f"rung inversion: {counts[lo]}x budget-{lo} vs "
+                f"{counts[hi]}x budget-{hi}"
+            )
     # all executor worker + heartbeat threads joined (small slack for the
     # daemonized asyncio server thread shared across experiments)
     time.sleep(0.5)
