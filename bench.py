@@ -79,11 +79,15 @@ def bench_training_throughput(quick: bool = False, cpu_fallback: bool = False):
         batch_size, seq_len, n_steps = 8, 64, 5
     else:
         # ~260M-param geometry: saturates one v5e chip's MXU without blowing
-        # HBM; scales to more chips via fsdp automatically. remat is required
-        # at this seq len: scanned layers would otherwise stack every layer's
-        # [S, S] attention residuals in HBM. head_dim=128 (8 heads) is the
-        # MXU-native layout — it lets the Pallas flash fwd+bwd kernel engage
-        # on the training path (Llama-3 itself uses head_dim 128).
+        # HBM; scales to more chips via fsdp automatically. remat_policy="dots"
+        # keeps matmul outputs and recomputes only elementwise work — measured
+        # fastest (BENCH_NOTES round 2: dots 58.5k vs nothing 42.6k tok/s at
+        # bs=8). head_dim=128 (8 heads) is the MXU-native layout (Llama-3
+        # itself uses head_dim 128), which lets auto_attention route to the
+        # Pallas flash kernel with its auto-tuned 512-row tiles — measured
+        # fastest at every S once the tiles are right (66.9k vs dense 60.7k
+        # tok/s at S=1024; the old 128x128 tiles LOST to dense, BENCH_NOTES).
+        # bs=16/chip was the best of {8, 16, 32}.
         cfg = DecoderConfig(
             vocab_size=32_000,
             d_model=1024,
@@ -94,7 +98,7 @@ def bench_training_throughput(quick: bool = False, cpu_fallback: bool = False):
             max_seq_len=1024,
             remat=True,
         )
-        batch_size = 8 * max(1, n_chips)
+        batch_size = 16 * max(1, n_chips)
         seq_len = 1024
         n_steps = 5 if quick else 20
 

@@ -113,6 +113,28 @@ def test_flash_kernel_matches_reference(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
 
 
+def test_flash_auto_blocks():
+    """Default tiles: measured-fastest MXU sizes that divide the sequence."""
+    from maggy_tpu.ops.flash import _auto_blocks
+
+    assert _auto_blocks(1024, 1024) == (512, 512)
+    assert _auto_blocks(8192, 8192) == (512, 1024)  # wide k tiles at long S
+    assert _auto_blocks(1280, 1280) == (256, 256)  # halved until they divide
+    assert _auto_blocks(128, 128) == (128, 128)
+
+
+def test_flash_default_blocks_match_reference():
+    """The auto-tuned default tiling (block_q/k=None) stays correct, fwd+bwd."""
+    q, k, v = qkv(b=1, s=256, h=2, d=128)
+    ref = default_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
+
+    g_ref = jax.grad(lambda q: (default_attention(q, k, v, causal=True) ** 2).sum())(q)
+    g_fl = jax.grad(lambda q: (flash_attention(q, k, v, causal=True) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(g_fl), np.asarray(g_ref), atol=2e-2, rtol=2e-2)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_backward_matches_reference(causal):
     """The Pallas backward kernels (dQ + dK/dV split) against jax.grad through
