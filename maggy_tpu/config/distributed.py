@@ -41,6 +41,7 @@ class DistributedConfig(LagomConfig):
         data_plane: str = "auto",
         worker_timeout: float = 1800.0,
         coordinator_port: Optional[int] = None,
+        evaluator: bool = False,
     ):
         """:param module: a flax ``nn.Module`` class, instance, or zero-arg factory —
             the analogue of the reference's torch module class argument
@@ -100,6 +101,12 @@ class DistributedConfig(LagomConfig):
         if coordinator_port is None and os.environ.get("MAGGY_TPU_COORDINATOR_PORT"):
             coordinator_port = int(os.environ["MAGGY_TPU_COORDINATOR_PORT"])
         self.coordinator_port = coordinator_port
+        # evaluator=True promotes the last worker to a dedicated evaluation
+        # role (the reference designates the last TF worker as evaluator,
+        # tf_dist_executor.py:138-144): it joins the control plane but not the
+        # training group; the train_fn sees ctx.role == "evaluator" and its
+        # outputs land under result["evaluator"] instead of the training mean.
+        self.evaluator = bool(evaluator)
 
     def resolve_sharding(self, num_devices: int) -> ShardingSpec:
         if isinstance(self.sharding, ShardingSpec):

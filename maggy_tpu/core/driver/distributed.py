@@ -33,6 +33,23 @@ class DistributedTrainingDriver(Driver):
         except Exception:
             default_workers = 1
         self.num_executors = config.num_executors or default_workers
+        # last worker becomes a dedicated evaluator (reference
+        # tf_dist_executor.py:138-144); it shares the control plane but not
+        # the training group
+        self.evaluator_partition: Any = None
+        if getattr(config, "evaluator", False):
+            if self.num_executors < 2:
+                raise ValueError(
+                    "evaluator=True needs num_executors >= 2 (one training "
+                    "worker plus the evaluator)"
+                )
+            if config.data_plane == "auto" and default_workers > 1:
+                raise ValueError(
+                    "evaluator=True requires data_plane='local': in a global "
+                    "jax.distributed mesh every process is part of the "
+                    "training collective and none can be carved out."
+                )
+            self.evaluator_partition = self.num_executors - 1
         self._finals: List[Dict[str, Any]] = []
         self._coordinator = None  # host:port of worker 0, filled at registration
         self._last_seen: Dict[int, float] = {}  # partition -> last contact ts
@@ -102,9 +119,13 @@ class DistributedTrainingDriver(Driver):
             coordinator = f"{host}:{port}"
         return {
             "type": "EXEC_CONFIG",
-            "num_processes": self.num_executors,
+            # the evaluator is outside the training group (reference: the TF
+            # evaluator is not in the TF_CONFIG worker list)
+            "num_processes": self.num_executors
+            - (1 if self.evaluator_partition is not None else 0),
             "coordinator": coordinator,
             "cluster": spec,
+            "evaluator_partition": self.evaluator_partition,
             "app_id": self.app_id,
             "run_id": self.run_id,
         }
@@ -151,10 +172,21 @@ class DistributedTrainingDriver(Driver):
 
     def _aggregate(self) -> None:
         """Average per-worker numeric test metrics (reference
-        torch_distributed_training_driver.py:49-69, 137-146)."""
-        outputs = [m.get("outputs") or {} for m in self._finals]
-        metrics = [m.get("metric") for m in self._finals if m.get("metric") is not None]
-        result: Dict[str, Any] = {"num_workers": len(self._finals)}
+        torch_distributed_training_driver.py:49-69, 137-146). The evaluator's
+        outputs are reported separately, never averaged into the training
+        mean (reference: the TF evaluator lives outside the worker list)."""
+        finals = self._finals
+        evaluator = None
+        if self.evaluator_partition is not None:
+            ev = [m for m in finals if m["partition_id"] == self.evaluator_partition]
+            finals = [m for m in finals if m["partition_id"] != self.evaluator_partition]
+            if ev:
+                evaluator = ev[0].get("outputs") or {}
+                if ev[0].get("metric") is not None:
+                    evaluator.setdefault("metric", ev[0]["metric"])
+        outputs = [m.get("outputs") or {} for m in finals]
+        metrics = [m.get("metric") for m in finals if m.get("metric") is not None]
+        result: Dict[str, Any] = {"num_workers": len(finals)}
         if metrics:
             result["metric"] = statistics.mean(metrics)
         keys = set().union(*outputs) if outputs else set()
@@ -162,6 +194,8 @@ class DistributedTrainingDriver(Driver):
             vals = [o[k] for o in outputs if isinstance(o.get(k), (int, float))]
             if vals:
                 result.setdefault("outputs", {})[k] = statistics.mean(vals)
+        if evaluator is not None:
+            result["evaluator"] = evaluator
         self.result = result
 
     def _exp_final_callback(self) -> None:
@@ -171,6 +205,40 @@ class DistributedTrainingDriver(Driver):
             self.result = flat
 
     # ------------------------------------------------------------------ executor
+
+    def init(self) -> None:
+        super().init()
+        # discovery: advertise host:port (+secret) under the experiment root so
+        # pod workers with only MAGGY_TPU_APP_ID + shared storage can connect
+        # (reference drivers register with Hopsworks REST, hopsworks.py:136-190).
+        # Pod mode only: a local run's loopback address would poison cross-host
+        # discovery and leak the secret to shared storage for nothing. A
+        # restarted driver re-registers under the same app_id, overwriting any
+        # record a killed predecessor left behind.
+        self._registered_driver = False
+        if self.pod_mode:
+            import socket as socket_mod
+
+            try:
+                self.env.register_driver(
+                    self.app_id, self.run_id, socket_mod.gethostname(),
+                    self.server.port, secret=self.server.secret,
+                )
+                self._registered_driver = True
+            except OSError as e:
+                # discovery-dependent workers would otherwise time out 120s
+                # later blaming a stale record — name the real failure now
+                self.log(
+                    f"WARNING: could not write driver registry record "
+                    f"{self.env.driver_registry_path(self.app_id)}: {e}; "
+                    f"workers must use MAGGY_TPU_DRIVER/MAGGY_TPU_SECRET"
+                )
+
+    def stop(self) -> None:
+        if getattr(self, "_registered_driver", False):
+            self.env.unregister_driver(self.app_id)
+            self._registered_driver = False
+        super().stop()
 
     def _local_partitions(self) -> List[int]:
         if not self.pod_mode:

@@ -74,6 +74,67 @@ class BaseEnv:
         self.mkdir(d)
         return d
 
+    # ---------------------------------------------------------- driver registry
+
+    def driver_registry_path(self, app_id: str) -> str:
+        # posixpath: correct for local linux paths AND gs:// URLs, so GcsEnv
+        # inherits the registry unchanged
+        import posixpath
+
+        return posixpath.join(self.root, ".drivers", f"{app_id}.json")
+
+    def register_driver(
+        self,
+        app_id: str,
+        run_id: int,
+        host: str,
+        port: int,
+        secret: Optional[str] = None,
+    ) -> None:
+        """Advertise a running driver so pod workers can find it by app id —
+        the storage-seam analogue of the reference registering its driver with
+        the Hopsworks REST endpoint (environment/hopsworks.py:136-190 posts
+        {hostIp, port, appId, secret} to /maggy/drivers). The record lives in
+        the experiment root (same trust domain as logs/checkpoints, like the
+        reference's registry); workers fall back to it when MAGGY_TPU_DRIVER /
+        MAGGY_TPU_SECRET env vars are not set."""
+        import time
+
+        record = {
+            "app_id": app_id,
+            "run_id": run_id,
+            "host": host,
+            "port": port,
+            "ts": time.time(),
+        }
+        if secret is not None:
+            record["secret"] = secret
+        self._atomic_dump(record, self.driver_registry_path(app_id))
+
+    def _atomic_dump(self, data: Any, path: str) -> None:
+        """Publish a JSON record atomically: a concurrently-polling worker must
+        see either no record or a complete one, never truncated JSON. Local
+        FS: temp file + rename. (GcsEnv inherits plain dump — a GCS object PUT
+        is already atomic at the object level.)"""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        self.dump(data, tmp)
+        os.replace(tmp, path)
+
+    def lookup_driver(self, app_id: str) -> Optional[dict]:
+        path = self.driver_registry_path(app_id)
+        try:
+            if not self.exists(path):
+                return None
+            return self.load_json(path)
+        except (OSError, ValueError):
+            return None
+
+    def unregister_driver(self, app_id: str) -> None:
+        try:
+            self.delete(self.driver_registry_path(app_id))
+        except OSError:
+            pass
+
     # ---------------------------------------------------------- cluster info
 
     def num_devices(self) -> int:

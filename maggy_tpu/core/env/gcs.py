@@ -53,6 +53,11 @@ class GcsEnv(BaseEnv):
     def listdir(self, path: str) -> List[str]:
         return sorted(posixpath.basename(p) for p in self.fs.ls(path))
 
+    def _atomic_dump(self, data, path: str) -> None:
+        # a GCS object PUT is atomic at the object level: readers see the old
+        # object or the new one, never a partial write — no rename dance needed
+        self.dump(data, path)
+
     def experiment_dir(self, app_id: str, run_id: int) -> str:
         d = posixpath.join(self.root, app_id, str(run_id))
         self.mkdir(d)

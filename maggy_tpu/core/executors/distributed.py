@@ -92,9 +92,25 @@ def dist_executor_fn(
             try:
                 retval = train_fn(**kwargs)
                 if retval is not None:
-                    # per-worker dir: concurrent workers must not clobber outputs
-                    metric = util.handle_return_val(retval, worker_dir, "metric")
-                    outputs = retval if isinstance(retval, dict) else {"metric": metric}
+                    if ctx.role == "evaluator":
+                        # evaluation outputs are free-form: not part of the
+                        # training mean, so no optimization-key requirement —
+                        # but persist them like every training worker does
+                        outputs = retval if isinstance(retval, dict) else {"value": retval}
+                        from maggy_tpu import constants
+
+                        try:
+                            os.makedirs(worker_dir, exist_ok=True)
+                            env.dump(
+                                util._jsonify(outputs),
+                                os.path.join(worker_dir, constants.OUTPUTS_FILE),
+                            )
+                        except OSError:
+                            reporter.log("Could not persist evaluator outputs")
+                    else:
+                        # per-worker dir: concurrent workers must not clobber outputs
+                        metric = util.handle_return_val(retval, worker_dir, "metric")
+                        outputs = retval if isinstance(retval, dict) else {"metric": metric}
             except EarlyStopException as e:
                 metric = e.metric
                 outputs = {"metric": metric}
@@ -116,6 +132,14 @@ def dist_executor_fn(
         num_processes = exec_config.get("num_processes", 1)
         data_plane = getattr(config, "data_plane", "auto")
         mesh_devices = devices if devices else None
+        if exec_config.get("evaluator_partition") == partition_id:
+            # dedicated evaluation role (reference tf_dist_executor.py:138-144):
+            # outside the training group, so never part of a global mesh —
+            # build a host-local context over this worker's device lease
+            n = len(mesh_devices) if mesh_devices is not None else len(jax.devices())
+            return TrainContext.create(
+                config.resolve_sharding(n), devices=mesh_devices, role="evaluator"
+            )
         pod = bool(exec_config.get("coordinator"))  # driver advertises this only in pod mode
         if data_plane == "auto":
             if jax.process_count() > 1:
@@ -137,7 +161,8 @@ def dist_executor_fn(
 
         n = len(mesh_devices) if mesh_devices is not None else len(jax.devices())
         spec = config.resolve_sharding(n)
-        return TrainContext.create(spec, devices=mesh_devices)
+        role = "chief" if partition_id == 0 else "worker"
+        return TrainContext.create(spec, devices=mesh_devices, role=role)
 
     return _executor
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 
 def initialize_data_plane(
@@ -95,6 +95,21 @@ def driver_address(config) -> Optional[str]:
     return os.environ.get("MAGGY_TPU_DRIVER") or getattr(config, "driver_addr", None)
 
 
+def discover_driver(app_id: str) -> Optional[dict]:
+    """Look up a running driver's {host, port, secret} by app id in the Env's
+    driver registry (shared storage) — the fallback when MAGGY_TPU_DRIVER /
+    MAGGY_TPU_SECRET are not set. Mirrors the reference's Hopsworks REST
+    driver discovery (environment/hopsworks.py:136-190).
+
+    Staleness: a SIGKILLed driver cannot unregister, so a record can outlive
+    its driver. A restarted driver overwrites the record at init; a worker
+    that discovered a dead record fails at the connect deadline with an error
+    naming the registry path (``_connect_with_deadline`` below)."""
+    from maggy_tpu.core.env import EnvSing
+
+    return EnvSing.get_instance().lookup_driver(app_id)
+
+
 def _parse_addr(addr: str) -> Tuple[str, int]:
     host, sep, port = addr.rpartition(":")
     if not sep or not port.isdigit():
@@ -104,14 +119,48 @@ def _parse_addr(addr: str) -> Tuple[str, int]:
     return host or "127.0.0.1", int(port)
 
 
-def worker_role(config) -> Optional[Tuple[str, int, str]]:
-    """Return (host, port, secret) if this process should run as a pod worker,
-    else None (run the driver)."""
-    addr = driver_address(config)
-    if not addr:
-        return None
+class WorkerRole(NamedTuple):
+    host: str
+    port: int
+    secret: str
+    via_registry: bool = False
+
+
+def worker_role(config) -> Optional[WorkerRole]:
+    """Return a :class:`WorkerRole` if this process should run as a pod
+    worker, else None (run the driver)."""
     explicit_role = os.environ.get("MAGGY_TPU_ROLE")
     if explicit_role == "driver":
+        return None
+    addr = driver_address(config)
+    discovered = None
+    app_id = os.environ.get("MAGGY_TPU_APP_ID")
+    if not addr and app_id:
+        # No explicit address: poll the shared-storage driver registry. An
+        # explicit worker waits out the driver's JAX bring-up (the record is
+        # written only once the RPC server is up) — without the wait, a
+        # worker that checks early would silently become a second driver and
+        # deadlock the reservation barrier.
+        deadline = time.time() + (
+            float(os.environ.get("MAGGY_TPU_CONNECT_TIMEOUT", "120"))
+            if explicit_role == "worker"
+            else 0.0
+        )
+        while True:
+            discovered = discover_driver(app_id)
+            if discovered or time.time() >= deadline:
+                break
+            time.sleep(0.5)
+        if discovered:
+            addr = f"{discovered['host']}:{discovered['port']}"
+    if not addr:
+        if explicit_role == "worker":
+            raise RuntimeError(
+                "MAGGY_TPU_ROLE=worker but no driver address: set "
+                "MAGGY_TPU_DRIVER=host:port, or make the driver's registry "
+                "record reachable (MAGGY_TPU_APP_ID + the driver's "
+                "MAGGY_TPU_LOG_ROOT on shared storage)."
+            )
         return None
     if explicit_role != "worker":
         # Infer from the JAX process index. Meaningful only when
@@ -126,11 +175,19 @@ def worker_role(config) -> Optional[Tuple[str, int, str]]:
             return None
     secret = os.environ.get("MAGGY_TPU_SECRET", "")
     if not secret:
+        # the registry can supply the secret even when the address came from
+        # MAGGY_TPU_DRIVER/driver_addr
+        if discovered is None and app_id:
+            discovered = discover_driver(app_id)
+        if discovered:
+            secret = discovered.get("secret", "")
+    if not secret:
         raise RuntimeError(
-            "Pod worker role needs MAGGY_TPU_SECRET (printed by the driver)."
+            "Pod worker role needs MAGGY_TPU_SECRET (printed by the driver) "
+            "or a driver-registry record reachable via MAGGY_TPU_APP_ID."
         )
     host, port = _parse_addr(addr)
-    return host, port, secret
+    return WorkerRole(host, port, secret, via_registry=discovered is not None)
 
 
 def partition_id() -> int:
@@ -148,9 +205,12 @@ def _connect_with_deadline(
     secret: str,
     deadline_s: float,
     hb_interval: float = 1.0,  # rpc.Client's own default
+    via_registry: bool = False,
 ):
     """Pod hosts start simultaneously; the driver may need many seconds of JAX
-    bring-up before it listens — retry well past Client's own 3 attempts."""
+    bring-up before it listens — retry well past Client's own 3 attempts.
+    ``via_registry`` marks an address that came from the discovery registry so
+    the timeout error can point at a possibly-stale record."""
     from maggy_tpu.core import rpc
     from maggy_tpu.exceptions import RpcError
 
@@ -159,15 +219,30 @@ def _connect_with_deadline(
     while True:
         try:
             return rpc.Client((host, port), pid, secret, hb_interval)
-        except RpcError:
+        except RpcError as e:
             if time.time() > deadline:
-                raise
+                hint = ""
+                if via_registry:
+                    from maggy_tpu.core.env import EnvSing
+
+                    app_id = os.environ.get("MAGGY_TPU_APP_ID", "<app>")
+                    hint = (
+                        f" (address came from the driver registry "
+                        f"{EnvSing.get_instance().driver_registry_path(app_id)};"
+                        f" the record may be stale — a SIGKILLed driver cannot"
+                        f" unregister)"
+                    )
+                raise RpcError(
+                    f"Could not reach driver at {host}:{port} within "
+                    f"{deadline_s:.0f}s{hint}: {e}"
+                ) from e
             time.sleep(delay)
             delay = min(delay * 1.5, 5.0)
 
 
 def run_worker(
-    train_fn: Callable, config, host: str, port: int, secret: str
+    train_fn: Callable, config, host: str, port: int, secret: str,
+    via_registry: bool = False,
 ) -> Any:
     """Run this process as one pod worker; returns the worker's outputs."""
     from maggy_tpu import util
@@ -181,7 +256,8 @@ def run_worker(
     app_id = os.environ.get("MAGGY_TPU_APP_ID")
     run_id = os.environ.get("MAGGY_TPU_RUN_ID")
     if app_id is None or run_id is None:
-        probe = _connect_with_deadline(host, port, pid, secret, connect_timeout)
+        probe = _connect_with_deadline(host, port, pid, secret, connect_timeout,
+                                       via_registry=via_registry)
         try:
             cfg_reply = probe._request({"type": "EXEC_CONFIG"})
             app_id = app_id or cfg_reply.get("app_id") or util.new_app_id()

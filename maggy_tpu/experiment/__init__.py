@@ -152,5 +152,7 @@ def _maybe_run_as_pod_worker(train_fn: Callable, config) -> Optional[Any]:
     role = pod.worker_role(config)
     if role is None:
         return None
-    host, port, secret = role
-    return pod.run_worker(train_fn, config, host, port, secret)
+    return pod.run_worker(
+        train_fn, config, role.host, role.port, role.secret,
+        via_registry=role.via_registry,
+    )

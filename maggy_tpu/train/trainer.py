@@ -281,9 +281,13 @@ class TrainContext:
     process_index: int = 0
     num_processes: int = 1
     rules: Tuple = shd.DEFAULT_RULES
+    # "chief" (worker 0) / "worker" / "evaluator" — the reference's TF role
+    # assignment (tf_dist_executor.py:138-144); an evaluator is outside the
+    # training group and should evaluate checkpoints instead of training
+    role: str = "worker"
 
     @classmethod
-    def create(cls, spec_or_preset="fsdp", devices=None) -> "TrainContext":
+    def create(cls, spec_or_preset="fsdp", devices=None, role="worker") -> "TrainContext":
         import jax as _jax
 
         from maggy_tpu.parallel.mesh import mesh_for
@@ -294,6 +298,7 @@ class TrainContext:
             spec=spec,
             process_index=_jax.process_index(),
             num_processes=_jax.process_count(),
+            role=role,
         )
 
     def trainer(self, model, optimizer, loss_fn: Callable = lm_loss_fn) -> Trainer:

@@ -1,0 +1,232 @@
+"""Driver discovery registry + dedicated evaluator role.
+
+Discovery: the driver advertises {host, port, secret} under the experiment
+root so pod workers with only an app id and shared storage can connect — the
+storage-seam analogue of the reference registering its driver with Hopsworks
+REST (environment/hopsworks.py:136-190). Evaluator: the last worker becomes a
+dedicated evaluation role outside the training group (reference
+tf_dist_executor.py:138-144).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import experiment
+from maggy_tpu.config import DistributedConfig
+from maggy_tpu.core.env.base import BaseEnv
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# --------------------------------------------------------------------- registry
+
+
+def test_registry_round_trip(tmp_path):
+    env = BaseEnv(str(tmp_path))
+    assert env.lookup_driver("app_x") is None
+    env.register_driver("app_x", 3, "host-a", 4242, secret="s3cr3t")
+    rec = env.lookup_driver("app_x")
+    assert rec["host"] == "host-a" and rec["port"] == 4242
+    assert rec["secret"] == "s3cr3t" and rec["run_id"] == 3
+    # re-registration (driver restart) overwrites
+    env.register_driver("app_x", 4, "host-b", 4343, secret="new")
+    assert env.lookup_driver("app_x")["port"] == 4343
+    env.unregister_driver("app_x")
+    assert env.lookup_driver("app_x") is None
+
+
+def test_worker_role_from_registry(tmp_env, monkeypatch):
+    """A worker with only MAGGY_TPU_APP_ID resolves address AND secret from
+    the registry; with MAGGY_TPU_DRIVER set it still pulls the secret."""
+    from maggy_tpu.core import pod
+
+    tmp_env.register_driver("app_d", 1, "driverhost", 5151, secret="tops")
+    cfg = DistributedConfig(num_executors=2)
+
+    monkeypatch.setenv("MAGGY_TPU_ROLE", "worker")
+    monkeypatch.setenv("MAGGY_TPU_APP_ID", "app_d")
+    monkeypatch.delenv("MAGGY_TPU_DRIVER", raising=False)
+    monkeypatch.delenv("MAGGY_TPU_SECRET", raising=False)
+    role = pod.worker_role(cfg)
+    assert role[:3] == ("driverhost", 5151, "tops") and role.via_registry
+
+    # explicit address + registry secret (review finding: the env-var address
+    # path must not disable the registry secret fallback)
+    monkeypatch.setenv("MAGGY_TPU_DRIVER", "10.0.0.9:6161")
+    assert pod.worker_role(cfg)[:3] == ("10.0.0.9", 6161, "tops")
+
+
+def test_explicit_worker_without_driver_raises(tmp_env, monkeypatch):
+    """MAGGY_TPU_ROLE=worker with no address and no registry record must fail
+    loudly instead of silently becoming a second driver (review finding)."""
+    from maggy_tpu.core import pod
+
+    monkeypatch.setenv("MAGGY_TPU_ROLE", "worker")
+    monkeypatch.setenv("MAGGY_TPU_APP_ID", "app_missing")
+    monkeypatch.setenv("MAGGY_TPU_CONNECT_TIMEOUT", "0.5")
+    monkeypatch.delenv("MAGGY_TPU_DRIVER", raising=False)
+    monkeypatch.delenv("MAGGY_TPU_SECRET", raising=False)
+    with pytest.raises(RuntimeError, match="no driver address"):
+        pod.worker_role(DistributedConfig(num_executors=2))
+
+
+def test_local_run_does_not_register(tmp_env):
+    """Non-pod drivers must not write loopback records (or secrets) to the
+    registry — they would poison cross-host discovery."""
+
+    def train(ctx, reporter):
+        return {"metric": 1.0}
+
+    experiment.lagom(
+        train,
+        DistributedConfig(
+            num_executors=1, sharding="dp", data_plane="local", hb_interval=0.05
+        ),
+    )
+    assert not os.path.isdir(os.path.join(tmp_env.root, ".drivers"))
+
+
+DISCOVERY_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from maggy_tpu import experiment
+    from maggy_tpu.config import DistributedConfig
+
+    def train(hparams, reporter, ctx):
+        return {{"metric": 2.0}}
+
+    result = experiment.lagom(
+        train,
+        DistributedConfig(num_executors=2, sharding="dp",
+                          data_plane="local", hb_interval=0.05),
+    )
+    print("WORKER-DONE", result)
+    """
+).format(repo=REPO)
+
+
+def test_pod_worker_discovers_driver(tmp_env, tmp_path):
+    """Full flow: pod driver registers; a second process finds it with ONLY
+    MAGGY_TPU_APP_ID + the shared root — no address/secret env vars."""
+    result_holder = {}
+
+    def train(hparams, reporter, ctx):
+        return {"metric": 2.0}
+
+    config = DistributedConfig(
+        num_executors=2,
+        sharding="dp",
+        data_plane="local",
+        driver_addr="127.0.0.1:auto",  # placeholder: flags pod mode
+        hb_interval=0.05,
+    )
+
+    t = threading.Thread(
+        target=lambda: result_holder.update(result=experiment.lagom(train, config))
+    )
+    t.start()
+    deadline = time.time() + 30
+    driver = None
+    while time.time() < deadline:
+        driver = experiment.CURRENT_DRIVER
+        if driver is not None and driver.server is not None and driver.server.port:
+            break
+        time.sleep(0.05)
+    assert driver is not None and driver.pod_mode
+
+    # the driver advertised itself; wait for the record
+    deadline = time.time() + 10
+    while time.time() < deadline and tmp_env.lookup_driver(driver.app_id) is None:
+        time.sleep(0.05)
+    rec = tmp_env.lookup_driver(driver.app_id)
+    assert rec is not None and rec["secret"] == driver.server.secret
+
+    script = tmp_path / "worker.py"
+    script.write_text(DISCOVERY_WORKER)
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("MAGGY_TPU_DRIVER", "MAGGY_TPU_SECRET")
+    }
+    env.update(
+        {
+            "MAGGY_TPU_ROLE": "worker",
+            "MAGGY_TPU_APP_ID": driver.app_id,
+            "MAGGY_TPU_PARTITION": "1",
+            # shared storage: same experiment root as the driver's Env
+            "MAGGY_TPU_LOG_ROOT": tmp_env.root,
+        }
+    )
+    # the registry records gethostname(); map it to loopback for the connect
+    env["MAGGY_TPU_DRIVER"] = f"127.0.0.1:{rec['port']}"
+    proc = subprocess.run(
+        [sys.executable, str(script)], env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "WORKER-DONE" in proc.stdout
+
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert result_holder["result"]["num_workers"] == 2
+    # driver unregisters on stop
+    assert tmp_env.lookup_driver(driver.app_id) is None
+
+
+# -------------------------------------------------------------------- evaluator
+
+
+def test_evaluator_role_e2e(tmp_env):
+    """num_executors=2 with evaluator=True: partition 1 sees
+    ctx.role == 'evaluator' and its outputs are reported separately, never
+    averaged into the training mean."""
+    seen_roles = {}
+
+    def train(ctx, reporter):
+        seen_roles[ctx.process_index if False else ctx.role] = True
+        if ctx.role == "evaluator":
+            return {"eval_loss": 0.5}
+        return {"metric": 1.0}
+
+    result = experiment.lagom(
+        train,
+        DistributedConfig(
+            num_executors=2,
+            sharding="dp",
+            data_plane="local",
+            evaluator=True,
+            hb_interval=0.05,
+        ),
+    )
+    assert seen_roles == {"chief": True, "evaluator": True}
+    assert result["num_workers"] == 1  # evaluator not in the training group
+    assert result["metric"] == pytest.approx(1.0)
+    assert result["evaluator"]["eval_loss"] == pytest.approx(0.5)
+    # evaluator outputs are persisted like every training worker's
+    import glob
+    import json
+
+    outs = glob.glob(os.path.join(tmp_env.root, "*", "*", "worker_1", ".outputs.json"))
+    assert outs and json.load(open(outs[0]))["eval_loss"] == pytest.approx(0.5)
+
+
+def test_evaluator_needs_two_workers(tmp_env):
+    def train(ctx):
+        return {"metric": 0.0}
+
+    with pytest.raises(ValueError, match="num_executors >= 2"):
+        experiment.lagom(
+            train,
+            DistributedConfig(num_executors=1, evaluator=True, data_plane="local"),
+        )
