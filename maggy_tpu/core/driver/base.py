@@ -152,10 +152,44 @@ class Driver(ABC):
         # a launcher (python -m maggy_tpu.run) pre-assigns the port so workers
         # can be started with MAGGY_TPU_DRIVER before the driver is up
         self.server.start(port=int(os.environ.get("MAGGY_TPU_BIND_PORT", "0")))
+        self._advertise()
         self._digestion_thread = threading.Thread(
             target=self._digest_loop, name="maggy-digestion", daemon=True
         )
         self._digestion_thread.start()
+
+    def _advertise(self) -> None:
+        """Write the driver-registry record (reference drivers register with
+        Hopsworks REST, hopsworks.py:136-190). Pod drivers advertise their
+        reachable hostname for cross-host worker bootstrap; every other driver
+        advertises loopback with scope="local", which worker discovery ignores
+        and monitor auto-attach (python -m maggy_tpu.monitor --latest) uses."""
+        self._registered_driver = False
+        pod = bool(getattr(self, "pod_mode", False))
+        if pod:
+            import socket as socket_mod
+
+            host, scope = socket_mod.gethostname(), "pod"
+        else:
+            host, scope = "127.0.0.1", "local"
+        try:
+            self.env.register_driver(
+                self.app_id, self.run_id, host, self.server.port,
+                secret=self.server.secret, scope=scope,
+            )
+            self._registered_driver = True
+        except OSError as e:
+            # pod workers relying on discovery would otherwise time out much
+            # later blaming a stale record — name the real failure now
+            self.log(
+                f"WARNING: could not write driver registry record "
+                f"{self.env.driver_registry_path(self.app_id)}: {e}"
+                + (
+                    "; workers must use MAGGY_TPU_DRIVER/MAGGY_TPU_SECRET"
+                    if pod
+                    else ""
+                )
+            )
 
     def _local_partitions(self) -> List[int]:
         """Partitions this process hosts; pod-mode drivers narrow this."""
@@ -219,6 +253,9 @@ class Driver(ABC):
 
     def stop(self) -> None:
         self.experiment_done.set()
+        if getattr(self, "_registered_driver", False):
+            self.env.unregister_driver(self.app_id)
+            self._registered_driver = False
         if self._digestion_thread is not None and self._digestion_thread.is_alive():
             self._digestion_thread.join(timeout=5)
         if self.server is not None:

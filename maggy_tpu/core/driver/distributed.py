@@ -206,40 +206,6 @@ class DistributedTrainingDriver(Driver):
 
     # ------------------------------------------------------------------ executor
 
-    def init(self) -> None:
-        super().init()
-        # discovery: advertise host:port (+secret) under the experiment root so
-        # pod workers with only MAGGY_TPU_APP_ID + shared storage can connect
-        # (reference drivers register with Hopsworks REST, hopsworks.py:136-190).
-        # Pod mode only: a local run's loopback address would poison cross-host
-        # discovery and leak the secret to shared storage for nothing. A
-        # restarted driver re-registers under the same app_id, overwriting any
-        # record a killed predecessor left behind.
-        self._registered_driver = False
-        if self.pod_mode:
-            import socket as socket_mod
-
-            try:
-                self.env.register_driver(
-                    self.app_id, self.run_id, socket_mod.gethostname(),
-                    self.server.port, secret=self.server.secret,
-                )
-                self._registered_driver = True
-            except OSError as e:
-                # discovery-dependent workers would otherwise time out 120s
-                # later blaming a stale record — name the real failure now
-                self.log(
-                    f"WARNING: could not write driver registry record "
-                    f"{self.env.driver_registry_path(self.app_id)}: {e}; "
-                    f"workers must use MAGGY_TPU_DRIVER/MAGGY_TPU_SECRET"
-                )
-
-    def stop(self) -> None:
-        if getattr(self, "_registered_driver", False):
-            self.env.unregister_driver(self.app_id)
-            self._registered_driver = False
-        super().stop()
-
     def _local_partitions(self) -> List[int]:
         if not self.pod_mode:
             return super()._local_partitions()

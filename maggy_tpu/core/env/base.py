@@ -90,14 +90,19 @@ class BaseEnv:
         host: str,
         port: int,
         secret: Optional[str] = None,
+        scope: str = "pod",
     ) -> None:
-        """Advertise a running driver so pod workers can find it by app id —
-        the storage-seam analogue of the reference registering its driver with
-        the Hopsworks REST endpoint (environment/hopsworks.py:136-190 posts
-        {hostIp, port, appId, secret} to /maggy/drivers). The record lives in
-        the experiment root (same trust domain as logs/checkpoints, like the
-        reference's registry); workers fall back to it when MAGGY_TPU_DRIVER /
-        MAGGY_TPU_SECRET env vars are not set."""
+        """Advertise a running driver so pod workers and monitors can find it
+        by app id — the storage-seam analogue of the reference registering its
+        driver with the Hopsworks REST endpoint (environment/hopsworks.py:
+        136-190 posts {hostIp, port, appId, secret} to /maggy/drivers). The
+        record lives in the experiment root (same trust domain as
+        logs/checkpoints, like the reference's registry).
+
+        ``scope``: "pod" records bootstrap remote workers (host must be
+        cross-host reachable); "local" records advertise a loopback address
+        for same-host monitor auto-attach ONLY — worker discovery ignores
+        them (a loopback record would poison cross-host bootstrap)."""
         import time
 
         record = {
@@ -105,11 +110,31 @@ class BaseEnv:
             "run_id": run_id,
             "host": host,
             "port": port,
+            "scope": scope,
             "ts": time.time(),
         }
         if secret is not None:
             record["secret"] = secret
         self._atomic_dump(record, self.driver_registry_path(app_id))
+
+    def list_drivers(self) -> List[dict]:
+        """All registry records, newest first (for monitor auto-attach)."""
+        import posixpath
+
+        out = []
+        d = posixpath.join(self.root, ".drivers")
+        try:
+            names = self.listdir(d)
+        except OSError:  # GcsEnv.listdir raises for missing paths
+            return out
+        for name in names:
+            if not name.endswith(".json") or ".tmp." in name:
+                continue
+            try:
+                out.append(self.load_json(posixpath.join(d, name)))
+            except (OSError, ValueError):
+                continue
+        return sorted(out, key=lambda r: r.get("ts", 0), reverse=True)
 
     def _atomic_dump(self, data: Any, path: str) -> None:
         """Publish a JSON record atomically: a concurrently-polling worker must

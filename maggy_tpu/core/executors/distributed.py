@@ -34,6 +34,7 @@ def dist_executor_fn(
     server_addr,
     secret: str,
     devices: Optional[list] = None,
+    via_registry: bool = False,
 ) -> Callable[[], None]:
     def _executor() -> None:
         env = EnvSing.get_instance()
@@ -54,6 +55,7 @@ def dist_executor_fn(
             secret,
             float(os.environ.get("MAGGY_TPU_CONNECT_TIMEOUT", "120")),
             hb_interval=config.hb_interval,
+            via_registry=via_registry,
         )
         try:
             client.register(meta={"host": socket_mod.gethostname()})
@@ -92,25 +94,14 @@ def dist_executor_fn(
             try:
                 retval = train_fn(**kwargs)
                 if retval is not None:
-                    if ctx.role == "evaluator":
-                        # evaluation outputs are free-form: not part of the
-                        # training mean, so no optimization-key requirement —
-                        # but persist them like every training worker does
-                        outputs = retval if isinstance(retval, dict) else {"value": retval}
-                        from maggy_tpu import constants
-
-                        try:
-                            os.makedirs(worker_dir, exist_ok=True)
-                            env.dump(
-                                util._jsonify(outputs),
-                                os.path.join(worker_dir, constants.OUTPUTS_FILE),
-                            )
-                        except OSError:
-                            reporter.log("Could not persist evaluator outputs")
-                    else:
-                        # per-worker dir: concurrent workers must not clobber outputs
-                        metric = util.handle_return_val(retval, worker_dir, "metric")
-                        outputs = retval if isinstance(retval, dict) else {"metric": metric}
+                    # per-worker dir: concurrent workers must not clobber
+                    # outputs. The evaluator's outputs are free-form (no
+                    # optimization-key requirement) but persist identically.
+                    metric = util.handle_return_val(
+                        retval, worker_dir, "metric",
+                        require_metric=ctx.role != "evaluator",
+                    )
+                    outputs = retval if isinstance(retval, dict) else {"metric": metric}
             except EarlyStopException as e:
                 metric = e.metric
                 outputs = {"metric": metric}

@@ -101,13 +101,20 @@ def discover_driver(app_id: str) -> Optional[dict]:
     MAGGY_TPU_SECRET are not set. Mirrors the reference's Hopsworks REST
     driver discovery (environment/hopsworks.py:136-190).
 
+    Only scope="pod" records qualify for worker bootstrap: "local" records
+    advertise a loopback address for same-host monitor attach and would
+    misdirect a remote worker to its own machine.
+
     Staleness: a SIGKILLed driver cannot unregister, so a record can outlive
     its driver. A restarted driver overwrites the record at init; a worker
     that discovered a dead record fails at the connect deadline with an error
     naming the registry path (``_connect_with_deadline`` below)."""
     from maggy_tpu.core.env import EnvSing
 
-    return EnvSing.get_instance().lookup_driver(app_id)
+    rec = EnvSing.get_instance().lookup_driver(app_id)
+    if rec is not None and rec.get("scope", "pod") != "pod":
+        return None
+    return rec
 
 
 def _parse_addr(addr: str) -> Tuple[str, int]:
@@ -173,6 +180,10 @@ def worker_role(config) -> Optional[WorkerRole]:
 
         if jax.process_index() == 0:
             return None
+    # via_registry marks the ADDRESS as registry-sourced (drives the
+    # stale-record hint on connect timeout) — a registry-sourced secret with
+    # an env-var address must not blame the registry for a bad address
+    addr_from_registry = discovered is not None
     secret = os.environ.get("MAGGY_TPU_SECRET", "")
     if not secret:
         # the registry can supply the secret even when the address came from
@@ -187,7 +198,7 @@ def worker_role(config) -> Optional[WorkerRole]:
             "or a driver-registry record reachable via MAGGY_TPU_APP_ID."
         )
     host, port = _parse_addr(addr)
-    return WorkerRole(host, port, secret, via_registry=discovered is not None)
+    return WorkerRole(host, port, secret, via_registry=addr_from_registry)
 
 
 def partition_id() -> int:
@@ -274,6 +285,7 @@ def run_worker(
         server_addr=(host, port),
         secret=secret,
         devices=None,  # pod worker spans its host's devices
+        via_registry=via_registry,
     )
     executor()
     return {"role": "worker", "partition_id": pid}

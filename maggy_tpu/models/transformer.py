@@ -313,6 +313,15 @@ class MLPBlock(nn.Module):
         )
 
 
+def _constrain_residual(x):
+    """Pin the residual stream's layout: batch over (data, fsdp), seq over sp,
+    embed replicated — on deep tp/fsdp/sp meshes GSPMD propagation can
+    otherwise drift into accidental activation all-gathers (TODO round 2)."""
+    from maggy_tpu.parallel.sharding import constrain_activation
+
+    return constrain_activation(x, ("batch", "activation_seq", None))
+
+
 class DecoderLayer(nn.Module):
     cfg: DecoderConfig
 
@@ -322,7 +331,7 @@ class DecoderLayer(nn.Module):
             RMSNorm(self.cfg, name="attn_norm")(x), positions
         )
         x = x + MLPBlock(self.cfg, name="mlp")(RMSNorm(self.cfg, name="mlp_norm")(x))
-        return x
+        return _constrain_residual(x)
 
 
 class _ScannedLayer(nn.Module):
@@ -353,7 +362,7 @@ class Decoder(nn.Module):
             (cfg.vocab_size, cfg.d_model),
             cfg.param_dtype,
         )
-        x = jnp.asarray(embed, cfg.dtype)[tokens]
+        x = _constrain_residual(jnp.asarray(embed, cfg.dtype)[tokens])
 
         layer_cls = _ScannedLayer
         if cfg.remat and not cfg.decode:  # no gradients (hence no remat) in decode

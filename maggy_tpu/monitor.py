@@ -2,10 +2,14 @@
 (reference rpc.py:490-502 + optimization_driver.py:412-431) as a CLI:
 
     python -m maggy_tpu.monitor <host:port> <secret> [--interval 1.0]
+    python -m maggy_tpu.monitor --latest            # auto-attach via registry
+    python -m maggy_tpu.monitor --app <app_id>      # attach a specific run
 
 Polls the driver's LOG verb, printing shipped log lines and the progress bar.
-Works against any running experiment (the driver logs its address at startup;
-in-process, ``experiment.CURRENT_DRIVER.server`` has host/port/secret).
+Auto-attach resolves host/port/secret from the driver registry every running
+driver writes under ``<MAGGY_TPU_LOG_ROOT>/.drivers/`` (the reference's
+Hopsworks REST driver registry, hopsworks.py:136-190, on the storage seam);
+explicit host:port + secret still works against any reachable driver.
 """
 
 from __future__ import annotations
@@ -13,6 +17,24 @@ from __future__ import annotations
 import argparse
 import sys
 import time
+
+
+def resolve_target(env, app_id=None):
+    """(host, port, secret) from the driver registry. ``app_id=None`` picks
+    the newest record. Raises LookupError when nothing is registered."""
+    if app_id:
+        rec = env.lookup_driver(app_id)
+        if rec is None:
+            raise LookupError(
+                f"No driver registered for app {app_id!r} under {env.root}"
+            )
+    else:
+        recs = env.list_drivers()
+        if not recs:
+            raise LookupError(f"No drivers registered under {env.root}")
+        rec = recs[0]
+    host = rec["host"] if rec.get("scope", "pod") == "pod" else "127.0.0.1"
+    return host, int(rec["port"]), rec.get("secret", "")
 
 
 def monitor(host: str, port: int, secret: str, interval: float = 1.0) -> int:
@@ -46,10 +68,27 @@ def monitor(host: str, port: int, secret: str, interval: float = 1.0) -> int:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("addr", help="driver host:port")
-    parser.add_argument("secret", help="experiment secret")
+    parser.add_argument("addr", nargs="?", help="driver host:port")
+    parser.add_argument("secret", nargs="?", help="experiment secret")
+    parser.add_argument("--app", help="auto-attach this app id via the registry")
+    parser.add_argument(
+        "--latest", action="store_true",
+        help="auto-attach the newest registered driver",
+    )
     parser.add_argument("--interval", type=float, default=1.0)
     args = parser.parse_args(argv)
+    if args.app or args.latest:
+        from maggy_tpu.core.env import EnvSing
+
+        try:
+            host, port, secret = resolve_target(EnvSing.get_instance(), args.app)
+        except LookupError as e:
+            print(f"[monitor] {e}", file=sys.stderr)
+            return 1
+        print(f"[monitor] attaching to {host}:{port}", flush=True)
+        return monitor(host, port, secret, args.interval)
+    if not args.addr or args.secret is None:
+        parser.error("need <addr> <secret>, or --app/--latest for auto-attach")
     from maggy_tpu.core.pod import _parse_addr
 
     try:

@@ -52,6 +52,18 @@ def logical_to_mesh_axes(
     return tuple(out)
 
 
+def mesh_extent(mesh, axis) -> int:
+    """Total device count behind a mesh-axis assignment (None / name / tuple)."""
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        n = 1
+        for a in axis:
+            n *= mesh.shape[a]
+        return n
+    return mesh.shape[axis]
+
+
 def partition_spec(logical_axes: Sequence[Optional[str]], rules=DEFAULT_RULES):
     from jax.sharding import PartitionSpec
 
@@ -79,23 +91,13 @@ def params_shardings(mesh, abstract_params, rules=DEFAULT_RULES):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
-    def mesh_extent(axis) -> int:
-        if axis is None:
-            return 1
-        if isinstance(axis, (tuple, list)):
-            n = 1
-            for a in axis:
-                n *= mesh.shape[a]
-            return n
-        return mesh.shape[axis]
-
     def leaf_sharding(leaf):
         if not isinstance(leaf, nn.Partitioned):
             return NamedSharding(mesh, PartitionSpec())
         shape = leaf.value.shape
         axes = list(logical_to_mesh_axes(leaf.names, rules))
         for i, axis in enumerate(axes):
-            ext = mesh_extent(axis)
+            ext = mesh_extent(mesh, axis)
             if ext > 1 and shape[i] % ext != 0:
                 logging.getLogger(__name__).warning(
                     "Axis %d of param (shape %s, logical %s) is not divisible by "
@@ -125,3 +127,35 @@ def unbox(tree):
 def batch_sharding(mesh, rules=DEFAULT_RULES):
     """Sharding for [batch, ...] host data: batch over (data, fsdp)."""
     return named_sharding(mesh, ("batch",), rules)
+
+
+def constrain_activation(x, logical_axes, rules=DEFAULT_RULES):
+    """Pin an activation's layout inside jit via ``with_sharding_constraint``.
+
+    GSPMD propagates shardings from parameters, but on deep mixed meshes
+    (tp x fsdp x sp) the residual stream between layers is where propagation
+    can drift into accidental all-gathers; pinning it (batch over
+    (data, fsdp), seq over sp, embed replicated) keeps collectives where the
+    design wants them. No-op without an ambient mesh, on single-device
+    meshes, and for axes that do not divide (GSPMD would insert padding —
+    a silent layout downgrade is better than a padded one).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from maggy_tpu.parallel.mesh import ambient_mesh
+
+    mesh = ambient_mesh()
+    if mesh is None or mesh.size == 1:
+        return x
+    axes = list(logical_to_mesh_axes(logical_axes, rules))
+    for i, axis in enumerate(axes):
+        ext = mesh_extent(mesh, axis)
+        if ext > 1 and x.shape[i] % ext:
+            axes[i] = None
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec(*axes))
+        )
+    except Exception:  # manual (shard_map) regions reject constraints
+        return x

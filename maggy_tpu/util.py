@@ -112,12 +112,17 @@ def handle_return_val(
     log_dir: Optional[str],
     optimization_key: str,
     log_file: Optional[str] = None,
-) -> float:
+    require_metric: bool = True,
+) -> Optional[float]:
     """Validate a train_fn return value and persist outputs (reference util.py:159-199).
 
     Numeric returns are used directly; dict returns must contain the optimization
     key with a numeric value. Writes ``.outputs.json`` and ``.metric`` into the
     trial log dir when one is given.
+
+    ``require_metric=False`` (evaluator role: free-form evaluation outputs)
+    accepts a dict without the optimization key — outputs are persisted,
+    the returned metric is None, and no ``.metric`` file is written.
     """
     if isinstance(return_val, constants.USER_FCT.NUMERIC_TYPES) and not isinstance(
         return_val, bool
@@ -126,13 +131,16 @@ def handle_return_val(
         outputs = {optimization_key: metric}
     elif isinstance(return_val, dict):
         if optimization_key not in return_val:
-            raise exceptions.ReturnTypeError(optimization_key, return_val)
-        metric = return_val[optimization_key]
-        if not isinstance(metric, constants.USER_FCT.NUMERIC_TYPES) or isinstance(
-            metric, bool
-        ):
-            raise exceptions.MetricTypeError(optimization_key, metric)
-        metric = float(metric)
+            if require_metric:
+                raise exceptions.ReturnTypeError(optimization_key, return_val)
+            metric = None
+        else:
+            metric = return_val[optimization_key]
+            if not isinstance(metric, constants.USER_FCT.NUMERIC_TYPES) or isinstance(
+                metric, bool
+            ):
+                raise exceptions.MetricTypeError(optimization_key, metric)
+            metric = float(metric)
         outputs = return_val
     elif return_val is None:
         raise exceptions.ReturnTypeError(optimization_key, return_val)
@@ -144,8 +152,9 @@ def handle_return_val(
             os.makedirs(log_dir, exist_ok=True)
             with open(os.path.join(log_dir, constants.OUTPUTS_FILE), "w") as f:
                 json.dump(_jsonify(outputs), f, sort_keys=True)
-            with open(os.path.join(log_dir, constants.METRIC_FILE), "w") as f:
-                f.write(repr(metric))
+            if metric is not None:
+                with open(os.path.join(log_dir, constants.METRIC_FILE), "w") as f:
+                    f.write(repr(metric))
         except OSError as e:
             logging.getLogger(__name__).warning(
                 "Could not persist trial outputs to %s: %s", log_dir, e

@@ -76,11 +76,38 @@ def test_explicit_worker_without_driver_raises(tmp_env, monkeypatch):
         pod.worker_role(DistributedConfig(num_executors=2))
 
 
-def test_local_run_does_not_register(tmp_env):
-    """Non-pod drivers must not write loopback records (or secrets) to the
-    registry — they would poison cross-host discovery."""
+def test_local_records_excluded_from_worker_bootstrap(tmp_env, monkeypatch):
+    """Non-pod drivers register scope='local' (for monitor auto-attach); pod
+    worker discovery must ignore those records — a loopback address would
+    misdirect a remote worker to its own machine."""
+    from maggy_tpu.core import pod
+
+    tmp_env.register_driver("app_l", 1, "127.0.0.1", 7777, secret="s",
+                            scope="local")
+    assert pod.discover_driver("app_l") is None  # worker bootstrap: ignored
+    # ...and an explicit worker that only has this local record fails loudly
+    monkeypatch.setenv("MAGGY_TPU_ROLE", "worker")
+    monkeypatch.setenv("MAGGY_TPU_APP_ID", "app_l")
+    monkeypatch.setenv("MAGGY_TPU_CONNECT_TIMEOUT", "0.5")
+    monkeypatch.delenv("MAGGY_TPU_DRIVER", raising=False)
+    monkeypatch.delenv("MAGGY_TPU_SECRET", raising=False)
+    with pytest.raises(RuntimeError, match="no driver address"):
+        pod.worker_role(DistributedConfig(num_executors=2))
+
+
+def test_local_run_registers_for_monitor_and_cleans_up(tmp_env):
+    """Every driver advertises itself while running (monitor auto-attach) and
+    unregisters on stop."""
+    from maggy_tpu import monitor as monitor_mod
+
+    seen = {}
 
     def train(ctx, reporter):
+        # mid-run: the registry record exists and resolve_target finds it
+        recs = tmp_env.list_drivers()
+        seen["recs"] = recs
+        if recs:
+            seen["target"] = monitor_mod.resolve_target(tmp_env)
         return {"metric": 1.0}
 
     experiment.lagom(
@@ -89,7 +116,11 @@ def test_local_run_does_not_register(tmp_env):
             num_executors=1, sharding="dp", data_plane="local", hb_interval=0.05
         ),
     )
-    assert not os.path.isdir(os.path.join(tmp_env.root, ".drivers"))
+    assert seen["recs"] and seen["recs"][0]["scope"] == "local"
+    host, port, secret = seen["target"]
+    assert host == "127.0.0.1" and port > 0 and secret
+    # unregistered after the experiment
+    assert tmp_env.list_drivers() == []
 
 
 DISCOVERY_WORKER = textwrap.dedent(
@@ -194,7 +225,7 @@ def test_evaluator_role_e2e(tmp_env):
     seen_roles = {}
 
     def train(ctx, reporter):
-        seen_roles[ctx.process_index if False else ctx.role] = True
+        seen_roles[ctx.role] = True
         if ctx.role == "evaluator":
             return {"eval_loss": 0.5}
         return {"metric": 1.0}
