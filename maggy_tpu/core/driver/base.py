@@ -178,7 +178,10 @@ class Driver(ABC):
                 secret=self.server.secret, scope=scope,
             )
             self._registered_driver = True
-        except OSError as e:
+        # broad: the record is best-effort on every non-pod path, and cloud
+        # storage raises non-OSError types (gcsfs HttpError, the RuntimeError
+        # GcsEnv raises without gcsfs) that must not kill the experiment
+        except Exception as e:  # noqa: BLE001
             # pod workers relying on discovery would otherwise time out much
             # later blaming a stale record — name the real failure now
             self.log(

@@ -101,7 +101,12 @@ def dist_executor_fn(
                         retval, worker_dir, "metric",
                         require_metric=ctx.role != "evaluator",
                     )
-                    outputs = retval if isinstance(retval, dict) else {"metric": metric}
+                    if isinstance(retval, dict):
+                        outputs = retval
+                    elif metric is not None:
+                        outputs = {"metric": metric}
+                    else:  # evaluator free-form non-dict return
+                        outputs = {"value": retval}
             except EarlyStopException as e:
                 metric = e.metric
                 outputs = {"metric": metric}

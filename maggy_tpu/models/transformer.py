@@ -27,10 +27,19 @@ import jax.numpy as jnp
 
 Dtype = Any
 
-# remat policies by name so configs stay JSON-friendly/hashable
+# remat policies by name so configs stay JSON-friendly/hashable.
+# "dots_attn" = "dots" plus the tensor tagged `checkpoint_name(.., "attn_out")`
+# (the attention kernel's output): it trades ~2 bytes/token/layer of HBM for
+# not re-running the flash forward in the backward. Measured a wash at S=1024
+# on v5e (65.3k vs 66.9k tok/s, within noise) — it becomes the right trade
+# when attention dominates (long S with remat still on).
 REMAT_POLICIES = {
     "nothing": jax.checkpoint_policies.nothing_saveable,
     "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "dots_attn": jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names("attn_out"),
+    ),
     "everything": jax.checkpoint_policies.everything_saveable,
 }
 
@@ -50,12 +59,13 @@ class DecoderConfig:
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
     remat: bool = False
-    # which intermediates remat keeps: "dots" saves projection/MLP matmul
-    # outputs (no-batch-dim dots) and recomputes only the cheap elementwise +
-    # attention-softmax work in the backward — ~1/3 less recompute FLOPs than
-    # "nothing" (round-1 bench burned 33% on full recompute); "nothing"
-    # recomputes the whole layer (minimum HBM, the long-context setting)
-    remat_policy: str = "dots"
+    # which intermediates remat keeps: "dots_attn" saves projection/MLP matmul
+    # outputs (no-batch-dim dots) plus the attention kernel's output, so the
+    # backward recomputes only cheap elementwise work — measured fastest on
+    # v5e at every S (BENCH_NOTES round 2; "nothing" costs ~27% at S=1024).
+    # "dots" drops the attention output (re-runs the flash forward in the
+    # backward); "nothing" recomputes the whole layer (minimum HBM).
+    remat_policy: str = "dots_attn"
     logits_softcap: float = 0.0
     tie_embeddings: bool = False
     attention_fn: Optional[Callable] = None
@@ -240,6 +250,11 @@ class Attention(nn.Module):
         else:
             attn = cfg.attention_fn or auto_attention
             out = attn(q, k, v, causal=True)
+            # under remat="dots" this tag saves the kernel output so the
+            # backward reads it instead of re-running the flash forward
+            from jax.ad_checkpoint import checkpoint_name
+
+            out = checkpoint_name(out, "attn_out")
         out = nn.DenseGeneral(
             features=cfg.d_model,
             axis=(-2, -1),

@@ -53,15 +53,20 @@ def logical_to_mesh_axes(
 
 
 def mesh_extent(mesh, axis) -> int:
-    """Total device count behind a mesh-axis assignment (None / name / tuple)."""
+    """Total device count behind a mesh-axis assignment (None / name / tuple).
+
+    Axes the mesh does not define count as 1 — an ambient user mesh without
+    the framework axis names must degrade (downstream NamedSharding
+    construction then decides), never KeyError at trace time."""
     if axis is None:
         return 1
+    shape = dict(mesh.shape)
     if isinstance(axis, (tuple, list)):
         n = 1
         for a in axis:
-            n *= mesh.shape[a]
+            n *= shape.get(a, 1)
         return n
-    return mesh.shape[axis]
+    return shape.get(axis, 1)
 
 
 def partition_spec(logical_axes: Sequence[Optional[str]], rules=DEFAULT_RULES):

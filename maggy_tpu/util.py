@@ -144,6 +144,10 @@ def handle_return_val(
         outputs = return_val
     elif return_val is None:
         raise exceptions.ReturnTypeError(optimization_key, return_val)
+    elif not require_metric:
+        # free-form evaluation artifacts (lists, strings, ...) persist as-is
+        metric = None
+        outputs = {"value": return_val}
     else:
         raise exceptions.ReturnTypeError(optimization_key, return_val)
 

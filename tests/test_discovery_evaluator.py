@@ -252,6 +252,26 @@ def test_evaluator_role_e2e(tmp_env):
     assert outs and json.load(open(outs[0]))["eval_loss"] == pytest.approx(0.5)
 
 
+def test_evaluator_free_form_returns(tmp_env):
+    """Evaluator returns need not be numeric or dict — a string/list persists
+    as {'value': ...} instead of killing the run (review finding)."""
+
+    def train(ctx, reporter):
+        if ctx.role == "evaluator":
+            return "checkpoint-500 looks best"
+        return {"metric": 2.0}
+
+    result = experiment.lagom(
+        train,
+        DistributedConfig(
+            num_executors=2, sharding="dp", data_plane="local",
+            evaluator=True, hb_interval=0.05,
+        ),
+    )
+    assert result["metric"] == pytest.approx(2.0)
+    assert result["evaluator"]["value"] == "checkpoint-500 looks best"
+
+
 def test_evaluator_needs_two_workers(tmp_env):
     def train(ctx):
         return {"metric": 0.0}
