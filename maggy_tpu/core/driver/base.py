@@ -149,6 +149,12 @@ class Driver(ABC):
     def init(self) -> None:
         self.server = self._make_server()
         self._register_msg_callbacks()
+        # structured snapshot for monitors — registered for every driver kind
+        # (the LOG verb ships lines; STATUS ships state — reference notebooks
+        # only had the former)
+        self.server.register_callback(
+            "STATUS", lambda m: {"type": "STATUS", **self._status()}
+        )
         # a launcher (python -m maggy_tpu.run) pre-assigns the port so workers
         # can be started with MAGGY_TPU_DRIVER before the driver is up
         self.server.start(port=int(os.environ.get("MAGGY_TPU_BIND_PORT", "0")))
@@ -295,3 +301,15 @@ class Driver(ABC):
 
     def progress(self) -> str:
         return ""
+
+    def _status(self) -> Dict[str, Any]:
+        """Structured snapshot for the STATUS verb; drivers extend it."""
+        return {
+            "kind": type(self).__name__,
+            "state": getattr(self, "_state", "UNKNOWN"),
+            "name": self.config.name,
+            "app_id": self.app_id,
+            "run_id": self.run_id,
+            "num_executors": self.num_executors,
+            "elapsed_s": time.time() - self.job_start if self.job_start else None,
+        }

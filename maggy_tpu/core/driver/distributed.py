@@ -198,6 +198,19 @@ class DistributedTrainingDriver(Driver):
             result["evaluator"] = evaluator
         self.result = result
 
+    def _status(self) -> Dict[str, Any]:
+        base = super()._status()
+        with self.lock:
+            base.update(
+                workers_done=len(self._final_pids),
+                evaluator_partition=self.evaluator_partition,
+                last_seen={
+                    str(pid): round(time.time() - ts, 1)
+                    for pid, ts in self._last_seen.items()
+                },
+            )
+        return base
+
     def _exp_final_callback(self) -> None:
         if self.result and "outputs" in self.result:
             flat = dict(self.result["outputs"])

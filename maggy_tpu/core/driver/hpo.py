@@ -38,6 +38,10 @@ class HyperparameterOptDriver(Driver):
 
         self.trial_store: Dict[str, Trial] = {}
         self.final_store: List[Trial] = []
+        # STATUS monitors tail recent controller decisions from memory
+        from collections import deque
+
+        self._controller_tail = deque(maxlen=40)
 
         # pruner (optional) — wired before the optimizer so it can override
         # num_trials (reference optimization_driver.py:88-89)
@@ -334,16 +338,24 @@ class HyperparameterOptDriver(Driver):
 
     # ------------------------------------------------------------------ results
 
+    def _ranked_done(self) -> List[Trial]:
+        """Finalized metric-bearing trials, best first (single source of the
+        ranking for both result.json and the live STATUS dashboard).
+        Call under self.lock."""
+        done = [t for t in self.final_store if t.final_metric is not None]
+        return sorted(
+            done, key=lambda t: t.final_metric, reverse=self.direction == "max"
+        )
+
     def _update_result(self) -> None:
         with self.lock:
-            done = [t for t in self.final_store if t.final_metric is not None]
+            ranked = self._ranked_done()
             errors = [t for t in self.final_store if t.status == Trial.ERROR]
             stopped = [t for t in self.final_store if t.info_dict.get("early_stopped")]
-        if not done:
+        if not ranked:
             self.result = {"num_trials": len(self.final_store), "best": None}
             return
-        reverse = self.direction == "max"
-        ranked = sorted(done, key=lambda t: t.final_metric, reverse=reverse)
+        done = ranked
         best, worst = ranked[0], ranked[-1]
         self.result = {
             "best": {
@@ -400,14 +412,47 @@ class HyperparameterOptDriver(Driver):
 
     def _controller_log(self, message: str) -> None:
         """Controller decision log (reference optimizer.log/pruner.log,
-        abstractoptimizer.py:84-134 + abstractpruner.py:72-85)."""
+        abstractoptimizer.py:84-134 + abstractpruner.py:72-85). Also kept in a
+        ring buffer so STATUS monitors can tail it without file access."""
+        line = f"[{time.strftime('%H:%M:%S')}] {message}"
+        with self.lock:
+            self._controller_tail.append(line)
         try:
             with self.env.open_file(
                 os.path.join(self.exp_dir, "optimizer.log"), "a"
             ) as f:
-                f.write(f"[{time.strftime('%H:%M:%S')}] {message}\n")
+                f.write(line + "\n")
         except OSError:
             pass
+
+    def _status(self):
+        base = super()._status()
+        with self.lock:
+            ranked = self._ranked_done()
+            best = None
+            if ranked:
+                best = {
+                    "trial_id": ranked[0].trial_id,
+                    "metric": ranked[0].final_metric,
+                    "params": ranked[0].params,
+                }
+            base.update(
+                controller=self.controller.name(),
+                direction=self.direction,
+                trials_done=len(self.final_store),
+                trials_total=self.num_trials,
+                trials_running=len(self.trial_store),
+                early_stopped=sum(
+                    1 for t in self.final_store
+                    if t.info_dict.get("early_stopped")
+                ),
+                errors=sum(
+                    1 for t in self.final_store if t.status == Trial.ERROR
+                ),
+                best=best,
+                controller_log=list(self._controller_tail),
+            )
+        return base
 
     # ------------------------------------------------------------------ executor
 

@@ -97,16 +97,10 @@ def dist_executor_fn(
                     # per-worker dir: concurrent workers must not clobber
                     # outputs. The evaluator's outputs are free-form (no
                     # optimization-key requirement) but persist identically.
-                    metric = util.handle_return_val(
-                        retval, worker_dir, "metric",
-                        require_metric=ctx.role != "evaluator",
+                    metric, outputs = util.normalize_return_val(
+                        retval, "metric", require_metric=ctx.role != "evaluator"
                     )
-                    if isinstance(retval, dict):
-                        outputs = retval
-                    elif metric is not None:
-                        outputs = {"metric": metric}
-                    else:  # evaluator free-form non-dict return
-                        outputs = {"value": retval}
+                    util.persist_outputs(outputs, metric, worker_dir)
             except EarlyStopException as e:
                 metric = e.metric
                 outputs = {"metric": metric}

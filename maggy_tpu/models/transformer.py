@@ -250,8 +250,9 @@ class Attention(nn.Module):
         else:
             attn = cfg.attention_fn or auto_attention
             out = attn(q, k, v, causal=True)
-            # under remat="dots" this tag saves the kernel output so the
+            # under remat="dots_attn" this tag saves the kernel output so the
             # backward reads it instead of re-running the flash forward
+            # (plain "dots" ignores the tag and recomputes)
             from jax.ad_checkpoint import checkpoint_name
 
             out = checkpoint_name(out, "attn_out")

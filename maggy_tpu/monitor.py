@@ -37,28 +37,112 @@ def resolve_target(env, app_id=None):
     return host, int(rec["port"]), rec.get("secret", "")
 
 
-def monitor(host: str, port: int, secret: str, interval: float = 1.0) -> int:
+def render_status(status: dict, width: int = 78) -> str:
+    """Format a STATUS snapshot as a plain-ANSI dashboard panel (no external
+    TUI dependency — the runtime image carries none)."""
+    from maggy_tpu import util
+
+    lines = []
+    head = (
+        f"{status.get('name', '?')} [{status.get('kind', '?')}] "
+        f"state={status.get('state', '?')} app={status.get('app_id', '?')}"
+        f"/{status.get('run_id', '?')}"
+    )
+    lines.append(head[:width])
+    elapsed = status.get("elapsed_s")
+    if status.get("trials_total") is not None:
+        done = status.get("trials_done", 0)
+        bar = util.progress_bar(done, status["trials_total"], width=28)
+        lines.append(
+            f"{bar}  running={status.get('trials_running', 0)} "
+            f"stopped={status.get('early_stopped', 0)} "
+            f"errors={status.get('errors', 0)}"
+            + (f"  {elapsed:.0f}s" if elapsed else "")
+        )
+        best = status.get("best")
+        if best:
+            params = ", ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in sorted(best.get("params", {}).items())
+            )
+            lines.append(
+                f"best {status.get('direction', '')} "
+                f"{best['metric']:.6g}  ({best['trial_id']})  {params}"[:width]
+            )
+        tail = status.get("controller_log") or []
+        if tail:
+            lines.append(f"-- {status.get('controller', 'controller')} decisions --")
+            lines.extend(line[:width] for line in tail[-8:])
+    elif status.get("workers_done") is not None:
+        lines.append(
+            f"workers {status['workers_done']}/{status.get('num_executors', '?')} done"
+            + (
+                f"  evaluator=partition {status['evaluator_partition']}"
+                if status.get("evaluator_partition") is not None
+                else ""
+            )
+            + (f"  {elapsed:.0f}s" if elapsed else "")
+        )
+        seen = status.get("last_seen") or {}
+        if seen:
+            def pid_key(kv):  # JSON stringifies pids; sort numerically
+                try:
+                    return (0, int(kv[0]))
+                except ValueError:
+                    return (1, kv[0])
+
+            lines.append(
+                "last heartbeat: "
+                + "  ".join(
+                    f"w{pid}:{age}s" for pid, age in sorted(seen.items(), key=pid_key)
+                )
+            )
+    return "\n".join(lines)
+
+
+def monitor(
+    host: str, port: int, secret: str, interval: float = 1.0,
+    dashboard: bool = False,
+) -> int:
     from maggy_tpu.core import rpc
     from maggy_tpu.exceptions import RpcError
 
+    from collections import deque
+
     client = rpc.Client((host, port), partition_id=-1, secret=secret)
     last_progress = ""
+    # the LOG verb destructively drains the driver buffer, so the dashboard
+    # accumulates every drained line locally and shows a rolling tail (plain
+    # mode prints everything as it arrives)
+    log_tail = deque(maxlen=500)
     try:
         while True:
             try:
                 reply = client._request({"type": "LOG"})
+                status = (
+                    client._request({"type": "STATUS"}) if dashboard else None
+                )
             except RpcError as e:
                 if "rejected" in str(e):
                     print(f"[monitor] {e}", flush=True)  # e.g. bad secret
                     return 1
                 print("[monitor] driver gone; exiting", flush=True)
                 return 0
-            for line in reply.get("logs") or []:
-                print(line, flush=True)
-            progress = reply.get("progress") or ""
-            if progress and progress != last_progress:
-                print(progress, flush=True)
-                last_progress = progress
+            if dashboard and status is not None:
+                log_tail.extend(reply.get("logs") or [])
+                panel = render_status(status)
+                # clear screen + home, then the panel and the rolling log tail
+                sys.stdout.write("\x1b[2J\x1b[H" + panel + "\n")
+                for line in list(log_tail)[-12:]:
+                    sys.stdout.write(line + "\n")
+                sys.stdout.flush()
+            else:
+                for line in reply.get("logs") or []:
+                    print(line, flush=True)
+                progress = reply.get("progress") or ""
+                if progress and progress != last_progress:
+                    print(progress, flush=True)
+                    last_progress = progress
             time.sleep(interval)
     except KeyboardInterrupt:
         return 0
@@ -76,6 +160,10 @@ def main(argv=None) -> int:
         help="auto-attach the newest registered driver",
     )
     parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument(
+        "--dashboard", action="store_true",
+        help="full-screen status panel (STATUS verb) instead of a log tail",
+    )
     args = parser.parse_args(argv)
     if args.app or args.latest:
         from maggy_tpu.core.env import EnvSing
@@ -86,7 +174,7 @@ def main(argv=None) -> int:
             print(f"[monitor] {e}", file=sys.stderr)
             return 1
         print(f"[monitor] attaching to {host}:{port}", flush=True)
-        return monitor(host, port, secret, args.interval)
+        return monitor(host, port, secret, args.interval, dashboard=args.dashboard)
     if not args.addr or args.secret is None:
         parser.error("need <addr> <secret>, or --app/--latest for auto-attach")
     from maggy_tpu.core.pod import _parse_addr
@@ -95,7 +183,7 @@ def main(argv=None) -> int:
         host, port = _parse_addr(args.addr)
     except ValueError as e:
         parser.error(str(e))
-    return monitor(host, port, args.secret, args.interval)
+    return monitor(host, port, args.secret, args.interval, dashboard=args.dashboard)
 
 
 if __name__ == "__main__":

@@ -107,6 +107,60 @@ def inject_kwargs(fn: Callable, available: Dict[str, Any]) -> Dict[str, Any]:
     return {k: v for k, v in available.items() if k in params}
 
 
+def normalize_return_val(
+    return_val: Any,
+    optimization_key: str,
+    require_metric: bool = True,
+) -> tuple:
+    """Map a train_fn return value to ``(metric, outputs)``.
+
+    Numeric returns are used directly; dict returns must contain the
+    optimization key with a numeric value. ``require_metric=False``
+    (evaluator role: free-form evaluation outputs) additionally accepts dicts
+    without the key, non-dict non-numeric values (persisted as
+    ``{"value": ...}``), and None — metric is then None.
+    """
+    if isinstance(return_val, constants.USER_FCT.NUMERIC_TYPES) and not isinstance(
+        return_val, bool
+    ):
+        return float(return_val), {optimization_key: float(return_val)}
+    if isinstance(return_val, dict):
+        if optimization_key not in return_val:
+            if require_metric:
+                raise exceptions.ReturnTypeError(optimization_key, return_val)
+            return None, return_val
+        metric = return_val[optimization_key]
+        if not isinstance(metric, constants.USER_FCT.NUMERIC_TYPES) or isinstance(
+            metric, bool
+        ):
+            raise exceptions.MetricTypeError(optimization_key, metric)
+        return float(metric), return_val
+    if not require_metric:
+        # free-form evaluation artifacts (lists, strings, None) persist as-is
+        return None, ({} if return_val is None else {"value": return_val})
+    raise exceptions.ReturnTypeError(optimization_key, return_val)
+
+
+def persist_outputs(
+    outputs: dict, metric: Optional[float], log_dir: Optional[str]
+) -> None:
+    """Write ``.outputs.json`` (+ ``.metric`` when one exists) into a trial/
+    worker dir; best-effort."""
+    if not log_dir:
+        return
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        with open(os.path.join(log_dir, constants.OUTPUTS_FILE), "w") as f:
+            json.dump(_jsonify(outputs), f, sort_keys=True)
+        if metric is not None:
+            with open(os.path.join(log_dir, constants.METRIC_FILE), "w") as f:
+                f.write(repr(metric))
+    except OSError as e:
+        logging.getLogger(__name__).warning(
+            "Could not persist trial outputs to %s: %s", log_dir, e
+        )
+
+
 def handle_return_val(
     return_val: Any,
     log_dir: Optional[str],
@@ -114,55 +168,10 @@ def handle_return_val(
     log_file: Optional[str] = None,
     require_metric: bool = True,
 ) -> Optional[float]:
-    """Validate a train_fn return value and persist outputs (reference util.py:159-199).
-
-    Numeric returns are used directly; dict returns must contain the optimization
-    key with a numeric value. Writes ``.outputs.json`` and ``.metric`` into the
-    trial log dir when one is given.
-
-    ``require_metric=False`` (evaluator role: free-form evaluation outputs)
-    accepts a dict without the optimization key — outputs are persisted,
-    the returned metric is None, and no ``.metric`` file is written.
-    """
-    if isinstance(return_val, constants.USER_FCT.NUMERIC_TYPES) and not isinstance(
-        return_val, bool
-    ):
-        metric = float(return_val)
-        outputs = {optimization_key: metric}
-    elif isinstance(return_val, dict):
-        if optimization_key not in return_val:
-            if require_metric:
-                raise exceptions.ReturnTypeError(optimization_key, return_val)
-            metric = None
-        else:
-            metric = return_val[optimization_key]
-            if not isinstance(metric, constants.USER_FCT.NUMERIC_TYPES) or isinstance(
-                metric, bool
-            ):
-                raise exceptions.MetricTypeError(optimization_key, metric)
-            metric = float(metric)
-        outputs = return_val
-    elif return_val is None:
-        raise exceptions.ReturnTypeError(optimization_key, return_val)
-    elif not require_metric:
-        # free-form evaluation artifacts (lists, strings, ...) persist as-is
-        metric = None
-        outputs = {"value": return_val}
-    else:
-        raise exceptions.ReturnTypeError(optimization_key, return_val)
-
-    if log_dir:
-        try:
-            os.makedirs(log_dir, exist_ok=True)
-            with open(os.path.join(log_dir, constants.OUTPUTS_FILE), "w") as f:
-                json.dump(_jsonify(outputs), f, sort_keys=True)
-            if metric is not None:
-                with open(os.path.join(log_dir, constants.METRIC_FILE), "w") as f:
-                    f.write(repr(metric))
-        except OSError as e:
-            logging.getLogger(__name__).warning(
-                "Could not persist trial outputs to %s: %s", log_dir, e
-            )
+    """Validate a train_fn return value and persist outputs (reference
+    util.py:159-199): :func:`normalize_return_val` + :func:`persist_outputs`."""
+    metric, outputs = normalize_return_val(return_val, optimization_key, require_metric)
+    persist_outputs(outputs, metric, log_dir)
     return metric
 
 

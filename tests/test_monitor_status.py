@@ -1,0 +1,120 @@
+"""STATUS verb + monitor dashboard: structured experiment snapshots for
+monitors (the reference only ships log lines via sparkmagic LOG polling)."""
+
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import Searchspace, experiment
+from maggy_tpu.config import HyperparameterOptConfig
+from maggy_tpu.core import rpc
+from maggy_tpu.monitor import render_status
+
+
+def test_status_verb_live_hpo(tmp_env):
+    """Attach a client mid-run and read a structured STATUS snapshot."""
+    release = threading.Event()
+    statuses = []
+
+    def train(hparams, reporter):
+        release.wait(timeout=30)
+        return hparams["x"]
+
+    cfg = HyperparameterOptConfig(
+        num_trials=4, optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0.0, 1.0])),
+        direction="max", num_executors=2, hb_interval=0.05, name="status-e2e",
+    )
+    holder = {}
+    t = threading.Thread(
+        target=lambda: holder.update(r=experiment.lagom(train, cfg))
+    )
+    t.start()
+    deadline = time.time() + 30
+    driver = None
+    while time.time() < deadline:
+        driver = experiment.CURRENT_DRIVER
+        if driver is not None and driver.server is not None and driver.server.port:
+            break
+        time.sleep(0.05)
+    assert driver is not None
+
+    client = rpc.Client(
+        ("127.0.0.1", driver.server.port), partition_id=-1,
+        secret=driver.server.secret,
+    )
+    try:
+        # first trial assignment happens on the digestion thread after worker
+        # REG — poll until the controller has recorded a decision
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status = client._request({"type": "STATUS"})
+            if status.get("controller_log"):
+                break
+            time.sleep(0.05)
+        statuses.append(status)
+    finally:
+        client.stop()
+        release.set()
+        t.join(timeout=60)
+
+    s = statuses[0]
+    assert s["kind"] == "HyperparameterOptDriver"
+    assert s["state"] == "RUNNING"
+    assert s["trials_total"] == 4
+    assert s["controller"] == "RandomSearch"
+    assert s["num_executors"] == 2
+    # decisions were recorded for the in-flight assignments
+    assert any("trial" in line for line in s["controller_log"])
+    assert holder["r"]["num_trials"] == 4
+
+
+def test_render_status_hpo_panel():
+    out = render_status(
+        {
+            "kind": "HyperparameterOptDriver",
+            "name": "exp",
+            "state": "RUNNING",
+            "app_id": "app_1",
+            "run_id": 1,
+            "elapsed_s": 12.5,
+            "direction": "max",
+            "controller": "asha",
+            "trials_done": 3,
+            "trials_total": 8,
+            "trials_running": 2,
+            "early_stopped": 1,
+            "errors": 0,
+            "best": {
+                "trial_id": "abcd", "metric": 0.91234,
+                "params": {"lr": 0.0031, "opt": "adam"},
+            },
+            "controller_log": ["[12:00:00] random trial abcd -> executor 0"],
+        }
+    )
+    assert "exp [HyperparameterOptDriver] state=RUNNING" in out
+    assert "3/8" in out
+    assert "best max 0.91234" in out and "lr=0.0031" in out
+    assert "asha decisions" in out
+    assert "executor 0" in out
+
+
+def test_render_status_distributed_panel():
+    out = render_status(
+        {
+            "kind": "DistributedTrainingDriver",
+            "name": "dist",
+            "state": "RUNNING",
+            "app_id": "a",
+            "run_id": 2,
+            "elapsed_s": 3.0,
+            "num_executors": 3,
+            "workers_done": 1,
+            "evaluator_partition": 2,
+            "last_seen": {"0": 0.2, "1": 0.1, "2": 5.0},
+        }
+    )
+    assert "workers 1/3 done" in out
+    assert "evaluator=partition 2" in out
+    assert "w2:5.0s" in out
