@@ -57,7 +57,7 @@ def render_status(status: dict, width: int = 78) -> str:
             f"{bar}  running={status.get('trials_running', 0)} "
             f"stopped={status.get('early_stopped', 0)} "
             f"errors={status.get('errors', 0)}"
-            + (f"  {elapsed:.0f}s" if elapsed else "")
+            + (f"  {elapsed:.0f}s" if elapsed is not None else "")
         )
         best = status.get("best")
         if best:
@@ -81,7 +81,7 @@ def render_status(status: dict, width: int = 78) -> str:
                 if status.get("evaluator_partition") is not None
                 else ""
             )
-            + (f"  {elapsed:.0f}s" if elapsed else "")
+            + (f"  {elapsed:.0f}s" if elapsed is not None else "")
         )
         seen = status.get("last_seen") or {}
         if seen:
@@ -119,17 +119,23 @@ def monitor(
         while True:
             try:
                 reply = client._request({"type": "LOG"})
+                # capture the (destructively drained) lines BEFORE the STATUS
+                # request — a driver dying between the two must not eat the
+                # final log lines that explain why
+                if dashboard:
+                    log_tail.extend(reply.get("logs") or [])
                 status = (
                     client._request({"type": "STATUS"}) if dashboard else None
                 )
             except RpcError as e:
+                for line in log_tail:
+                    print(line, flush=True)
                 if "rejected" in str(e):
                     print(f"[monitor] {e}", flush=True)  # e.g. bad secret
                     return 1
                 print("[monitor] driver gone; exiting", flush=True)
                 return 0
             if dashboard and status is not None:
-                log_tail.extend(reply.get("logs") or [])
                 panel = render_status(status)
                 # clear screen + home, then the panel and the rolling log tail
                 sys.stdout.write("\x1b[2J\x1b[H" + panel + "\n")

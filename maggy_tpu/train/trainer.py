@@ -290,8 +290,11 @@ class TrainContext:
     def create(cls, spec_or_preset="fsdp", devices=None, role="worker") -> "TrainContext":
         import jax as _jax
 
+        from maggy_tpu import util
         from maggy_tpu.parallel.mesh import mesh_for
 
+        # one XLA compile per geometry across trials/instances/processes
+        util.enable_compilation_cache()
         mesh, spec = mesh_for(sharding=spec_or_preset, devices=devices)
         return cls(
             mesh=mesh,

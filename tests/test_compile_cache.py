@@ -1,0 +1,64 @@
+"""Persistent XLA compilation cache: one compile per geometry across Trainer
+instances/trials/processes (each Trainer jits its own step closure, so
+without this N same-geometry HPO trials pay N full compiles)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from maggy_tpu import util
+
+    d = util.enable_compilation_cache()
+    if os.environ.get("MAGGY_TPU_COMPILE_CACHE") == "1":
+        assert d is not None and os.path.isdir(d), d
+        assert jax.config.jax_compilation_cache_dir == d
+        # idempotent
+        assert util.enable_compilation_cache() == d
+    else:
+        # CPU backend without the force flag: disabled (XLA:CPU AOT reload
+        # can SIGILL across machine-feature drift)
+        assert d is None, d
+        assert not jax.config.jax_compilation_cache_dir
+    print("CACHE-OK", d)
+    """
+).format(repo=REPO)
+
+
+def _run(env_overrides, tmp_path):
+    script = tmp_path / "cache_probe.py"
+    script.write_text(SCRIPT)
+    env = dict(os.environ)
+    env.pop("MAGGY_TPU_COMPILE_CACHE", None)
+    env["MAGGY_TPU_COMPILE_CACHE_DIR"] = str(tmp_path / "xcache")
+    env.update(env_overrides)
+    proc = subprocess.run(
+        [sys.executable, str(script)], env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_cache_enabled_when_forced(tmp_path):
+    out = _run({"MAGGY_TPU_COMPILE_CACHE": "1"}, tmp_path)
+    assert "CACHE-OK" in out and "xcache" in out
+
+
+def test_cache_skipped_on_cpu_by_default(tmp_path):
+    out = _run({}, tmp_path)
+    assert "CACHE-OK None" in out
+
+
+def test_cache_disabled_explicitly(tmp_path):
+    out = _run({"MAGGY_TPU_COMPILE_CACHE": "0"}, tmp_path)
+    assert "CACHE-OK None" in out
