@@ -43,6 +43,18 @@ def trial_executor_fn(
     devices: Optional[list] = None,
     resolve: Optional[Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]]] = None,
 ) -> Callable[[], None]:
+    # one lease-wide TrainContext shared by every trial this worker runs
+    # (same devices -> same mesh; built only if the train_fn asks for it,
+    # so metric-only train_fns never touch jax)
+    _ctx_cache: Dict[str, Any] = {}
+
+    def _lease_ctx():
+        if "ctx" not in _ctx_cache:
+            from maggy_tpu.train.trainer import TrainContext
+
+            _ctx_cache["ctx"] = TrainContext.create("dp", devices=devices or None)
+        return _ctx_cache["ctx"]
+
     def _executor() -> None:
         env = EnvSing.get_instance()
         exp_dir = env.experiment_dir(app_id, run_id)
