@@ -178,10 +178,16 @@ class Driver(ABC):
             host, scope = socket_mod.gethostname(), "pod"
         else:
             host, scope = "127.0.0.1", "local"
+        # The registry record lives in the experiment root, so anyone who can
+        # read that storage can join the control plane with the embedded
+        # secret. On shared buckets set MAGGY_TPU_REGISTRY_NO_SECRET=1 to
+        # register address-only; workers/monitors then need MAGGY_TPU_SECRET
+        # out-of-band (docs/distributed.md "Trust boundary").
+        omit_secret = os.environ.get("MAGGY_TPU_REGISTRY_NO_SECRET", "") not in ("", "0")
         try:
             self.env.register_driver(
                 self.app_id, self.run_id, host, self.server.port,
-                secret=self.server.secret, scope=scope,
+                secret=None if omit_secret else self.server.secret, scope=scope,
             )
             self._registered_driver = True
         # broad: the record is best-effort on every non-pod path, and cloud

@@ -108,6 +108,12 @@ def trial_executor_fn(
         if resolve is not None:
             # experiment-kind hook: ablation swaps in per-trial model/dataset
             available = resolve(params, available)
+        import inspect as _inspect
+
+        if "ctx" in _inspect.signature(train_fn).parameters:
+            # lease-wide TrainContext, built only when the train_fn asks for
+            # it so metric-only train_fns never touch jax
+            available["ctx"] = _lease_ctx()
         kwargs = util.inject_kwargs(train_fn, available)
 
         metric: Optional[float] = None

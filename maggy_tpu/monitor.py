@@ -15,6 +15,7 @@ explicit host:port + secret still works against any reachable driver.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -34,7 +35,10 @@ def resolve_target(env, app_id=None):
             raise LookupError(f"No drivers registered under {env.root}")
         rec = recs[0]
     host = rec["host"] if rec.get("scope", "pod") == "pod" else "127.0.0.1"
-    return host, int(rec["port"]), rec.get("secret", "")
+    # address-only records (MAGGY_TPU_REGISTRY_NO_SECRET=1 drivers) rely on
+    # the secret arriving out-of-band via env
+    secret = rec.get("secret") or os.environ.get("MAGGY_TPU_SECRET", "")
+    return host, int(rec["port"]), secret
 
 
 def render_status(status: dict, width: int = 78) -> str:
@@ -109,7 +113,18 @@ def monitor(
 
     from collections import deque
 
-    client = rpc.Client((host, port), partition_id=-1, secret=secret)
+    try:
+        client = rpc.Client((host, port), partition_id=-1, secret=secret)
+    except RpcError as e:
+        # A SIGKILLed driver cannot unregister, so a registry record may
+        # outlive its driver — surface that instead of a raw traceback.
+        print(
+            f"[monitor] cannot reach driver at {host}:{port}: {e}\n"
+            "[monitor] if you attached via --latest/--app, the registry "
+            "record may be stale (driver killed before it could unregister)",
+            file=sys.stderr,
+        )
+        return 1
     last_progress = ""
     # the LOG verb destructively drains the driver buffer, so the dashboard
     # accumulates every drained line locally and shows a rolling tail (plain

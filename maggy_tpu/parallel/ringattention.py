@@ -90,15 +90,19 @@ def ring_attention(
         rotation; fully differentiable). ``"pallas"`` — the
         :mod:`maggy_tpu.ops.ring_flash` kernel: the KV rotation is issued
         in-kernel via ``make_async_remote_copy`` and explicitly overlapped
-        with the block compute. Its backward re-runs the XLA ring under
-        ``jax.vjp`` (recompute, the standard ring-attention trade).
+        with the block compute, forward AND backward (the bwd ring rotates
+        (k, v, dk, dv) together, recomputing probabilities from the saved
+        LSE). ``"auto"`` — pallas on TPU, xla elsewhere (the interpret
+        machine is for correctness tests, not speed).
     :param interpret: pallas only — run under the TPU interpret machine
         (defaults to True off-TPU so CPU meshes can test the kernel).
     """
     if segment_ids is not None:
         raise NotImplementedError("ring attention does not support segment_ids yet")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl not in ("xla", "pallas"):
-        raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
+        raise ValueError(f"impl must be 'xla', 'pallas', or 'auto', got {impl!r}")
     num_shards = mesh.shape[axis_name]
     if num_shards == 1:
         return ops_attn.blockwise_attention(q, k, v, causal=causal)
@@ -134,34 +138,18 @@ def _pallas_ring(q, k, v, *, mesh, causal, axis_name, interpret):
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-
-    @jax.custom_vjp
-    def attn(q, k, v):
-        return ring_flash_attention(
-            q, k, v, mesh=mesh, causal=causal, axis_name=axis_name,
-            interpret=interpret,
-        )
-
-    def fwd(q, k, v):
-        return attn(q, k, v), (q, k, v)
-
-    def bwd(res, g):
-        q, k, v = res
-        _, pull = jax.vjp(
-            functools.partial(
-                _xla_ring, mesh=mesh, causal=causal, axis_name=axis_name
-            ),
-            q, k, v,
-        )
-        return pull(g)
-
-    attn.defvjp(fwd, bwd)
-    return attn(q, k, v)
+    # the kernel carries its own custom_vjp (ring backward with rotating
+    # dk/dv accumulators) — nothing to wrap here
+    return ring_flash_attention(
+        q, k, v, mesh=mesh, causal=causal, axis_name=axis_name,
+        interpret=interpret,
+    )
 
 
-def make_ring_attention(mesh, axis_name: str = AXIS_SEQ, impl: str = "xla"):
+def make_ring_attention(mesh, axis_name: str = AXIS_SEQ, impl: str = "auto"):
     """Build an ``attention_fn`` for DecoderConfig: same signature as
-    ``default_attention``."""
+    ``default_attention``. ``impl="auto"`` trains through the RDMA Pallas
+    kernel (fwd+bwd) on TPU and the ppermute ring elsewhere."""
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
         return ring_attention(

@@ -70,6 +70,7 @@ def test_ring_matches_reference(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_gqa_and_grads():
     mesh = make_mesh(ShardingSpec(sp=4, dp=2))
     q, k, v = qkv(b=2, s=32, h=8, kh=4, d=8)
@@ -244,6 +245,7 @@ def test_flash_fallback_on_odd_shapes():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_decoder_with_ring_attention_e2e():
     """Decoder runs unchanged with ring attention as its attention_fn on an
     sp mesh — the long-context config."""

@@ -15,6 +15,7 @@ from maggy_tpu.train.checkpoint import Checkpointer, load_finalized_trials
 from maggy_tpu.train.data import synthetic_lm_batches
 
 
+@pytest.mark.slow
 def test_sharded_state_roundtrip(tmp_path):
     cfg = DecoderConfig.tiny()
     ctx = TrainContext.create(ShardingSpec(dp=2, fsdp=2, tp=2))
@@ -49,6 +50,7 @@ def test_sharded_state_roundtrip(tmp_path):
     assert int(restored.step) == 4
 
 
+@pytest.mark.slow
 def test_cross_mesh_restore(tmp_path):
     """A checkpoint saved under one ShardingSpec restores onto a different
     mesh layout (orbax reshards to the template's NamedShardings) and training

@@ -281,3 +281,31 @@ def test_evaluator_needs_two_workers(tmp_env):
             train,
             DistributedConfig(num_executors=1, evaluator=True, data_plane="local"),
         )
+
+
+def test_registry_no_secret_opt_out(tmp_env, monkeypatch):
+    """MAGGY_TPU_REGISTRY_NO_SECRET=1 registers address-only records (shared
+    buckets: read access to the root must not grant control-plane access);
+    the monitor then resolves the secret from MAGGY_TPU_SECRET."""
+    from maggy_tpu import monitor as monitor_mod
+
+    monkeypatch.setenv("MAGGY_TPU_REGISTRY_NO_SECRET", "1")
+    seen = {}
+
+    def train(ctx, reporter):
+        seen["recs"] = tmp_env.list_drivers()
+        return {"metric": 1.0}
+
+    experiment.lagom(
+        train,
+        DistributedConfig(
+            num_executors=1, sharding="dp", data_plane="local", hb_interval=0.05
+        ),
+    )
+    assert seen["recs"] and "secret" not in seen["recs"][0]
+    # re-register a record to resolve against (driver unregistered on stop)
+    tmp_env.register_driver("app_ns", 1, "127.0.0.1", 4141, secret=None,
+                            scope="local")
+    monkeypatch.setenv("MAGGY_TPU_SECRET", "oob-secret")
+    host, port, secret = monitor_mod.resolve_target(tmp_env, "app_ns")
+    assert secret == "oob-secret"

@@ -50,6 +50,7 @@ def test_sharded_state_placement():
 
 
 @pytest.mark.parametrize("preset", ["dp", "fsdp", "2d"])
+@pytest.mark.slow
 def test_training_learns_under_preset(preset):
     cfg = DecoderConfig.tiny()
     ctx = TrainContext.create(preset)
@@ -65,6 +66,7 @@ def test_training_learns_under_preset(preset):
     assert last < first * 0.85, (preset, first, last)
 
 
+@pytest.mark.slow
 def test_dp_and_fsdp_agree():
     """Same seed, same data: the sharding layout must not change the math."""
     cfg = DecoderConfig.tiny()
@@ -82,6 +84,7 @@ def test_dp_and_fsdp_agree():
     np.testing.assert_allclose(losses["dp"], losses["fsdp"], rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_lagom_distributed_e2e(tmp_env):
     """Oblivious distributed train_fn through the lagom front door."""
     cfg = DecoderConfig.tiny()
@@ -106,6 +109,7 @@ def test_lagom_distributed_e2e(tmp_env):
     assert result["loss"] < 5.5
 
 
+@pytest.mark.slow
 def test_graft_entry_and_dryrun():
     import importlib.util
     import os

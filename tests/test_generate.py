@@ -12,6 +12,8 @@ from maggy_tpu.models.generate import generate
 from maggy_tpu.train import TrainContext
 from maggy_tpu.train.data import synthetic_lm_batches
 
+pytestmark = pytest.mark.slow  # module fixture trains a model (~17s setup)
+
 
 @pytest.fixture(scope="module")
 def trained():

@@ -25,6 +25,7 @@ def setup():
     return cfg, model, decode_model, variables, tokens
 
 
+@pytest.mark.slow
 def test_incremental_matches_full_forward(setup):
     cfg, model, decode_model, variables, tokens = setup
     full = np.asarray(model.apply(variables, tokens))
@@ -64,6 +65,7 @@ def test_cached_generation_matches_recompute(setup):
     assert (a == b).mean() > 0.95  # bf16 ties may break differently
 
 
+@pytest.mark.slow
 def test_moe_decoder_cached_generation():
     """The MoE decoder shares the Attention module, so KV-cache decode works
     for it too. (Note: per-step routing never drops tokens — capacity >=
@@ -120,6 +122,7 @@ def test_cached_generation_eos(setup):
     assert hits.size and (out[0, hits[0]:] == eos).all()
 
 
+@pytest.mark.slow
 def test_tp_decode_cache_sharded():
     """On a tp mesh the KV cache shards its kv-head dim over tensor (1/tp per
     device, not a full replica) and cached generation still matches the

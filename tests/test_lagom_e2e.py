@@ -180,3 +180,27 @@ def test_lagom_single_experiment_guard(tmp_env):
     finally:
         release.set()
         t.join(timeout=10)
+
+
+def test_lagom_injects_train_context(tmp_env):
+    """A train_fn asking for ``ctx`` gets a lease-wide TrainContext (built
+    lazily — metric-only train_fns never touch jax)."""
+    seen = {}
+
+    def train(hparams, ctx):
+        seen["ctx"] = ctx
+        return 1.0
+
+    cfg = HyperparameterOptConfig(
+        num_trials=1,
+        optimizer="randomsearch",
+        searchspace=space(),
+        num_executors=1,
+        es_policy="none",
+        hb_interval=0.05,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] == 1
+    from maggy_tpu.train.trainer import TrainContext
+
+    assert isinstance(seen["ctx"], TrainContext)

@@ -25,6 +25,7 @@ def test_moe_forward_and_routing():
     assert moe_params["w_gate"].value.shape == (cfg.n_layers, cfg.n_experts, 64, 96)
 
 
+@pytest.mark.slow
 def test_moe_trains_expert_parallel():
     """MoE decoder learns under an ep x fsdp mesh (BASELINE config 5 shape)."""
     cfg = MoEConfig.tiny_moe()
@@ -60,6 +61,7 @@ def test_moe_capacity_drops_are_bounded():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_resnet_forward():
     cfg = ResNetConfig.resnet18(num_classes=10)
     model = ResNet(cfg)
@@ -70,6 +72,7 @@ def test_resnet_forward():
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_resnet_learns():
     cfg = ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=2, dtype=jnp.float32)
     model = ResNet(cfg)

@@ -96,6 +96,7 @@ def test_unclosed_loader_is_collectable():
     assert not thread.is_alive(), "producer thread did not exit after collection"
 
 
+@pytest.mark.slow
 def test_feeds_trainer():
     """Loader output flows straight into the sharded trainer."""
     import jax

@@ -105,6 +105,7 @@ def test_pipeline_scatter_output_matches_replicated():
 
 
 @pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 8), (4, 5)])
+@pytest.mark.slow
 def test_1f1b_matches_gpipe_autodiff(n_stages, n_micro):
     """The explicit 1F1B schedule produces the same loss and parameter grads
     as jax.grad through the GPipe schedule (and hence as the sequential

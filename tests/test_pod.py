@@ -15,6 +15,8 @@ import pytest
 from maggy_tpu import experiment
 from maggy_tpu.config import DistributedConfig
 
+pytestmark = pytest.mark.slow  # subprocess/multi-process tier
+
 WORKER_SCRIPT = textwrap.dedent(
     """
     import os, sys

@@ -11,6 +11,8 @@ from maggy_tpu import experiment
 from maggy_tpu.config import DistributedConfig
 from maggy_tpu.core import rpc
 
+pytestmark = pytest.mark.slow  # subprocess/multi-process tier
+
 
 def test_silent_pod_worker_aborts(tmp_env):
     def train(hparams, reporter, ctx):

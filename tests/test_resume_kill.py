@@ -13,6 +13,10 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess/multi-process tier
+
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 RUN_SCRIPT = textwrap.dedent(

@@ -11,6 +11,8 @@ import pytest
 from maggy_tpu import Searchspace, Trial
 from maggy_tpu.core import rpc
 
+pytestmark = pytest.mark.slow  # subprocess/multi-process tier
+
 
 @pytest.fixture()
 def server():

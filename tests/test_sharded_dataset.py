@@ -115,6 +115,7 @@ def test_validation_errors(tmp_path):
         write_sharded(str(tmp_path / "bad"), {"a": np.zeros(4), "b": np.zeros(5)}, 2)
 
 
+@pytest.mark.slow
 def test_train_from_disk_shards(tmp_path):
     """End-to-end: a decoder trains from on-disk shards it never fully loads."""
     import jax

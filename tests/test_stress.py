@@ -11,6 +11,8 @@ import pytest
 from maggy_tpu import Searchspace, experiment
 from maggy_tpu.config import HyperparameterOptConfig
 
+pytestmark = pytest.mark.slow  # subprocess/multi-process tier
+
 
 def test_hpo_stress_no_lost_or_duplicated_trials(tmp_env):
     ran = []

@@ -1,0 +1,22 @@
+#!/bin/bash
+# TPU tunnel watchdog: probe liveness every ~7 min; on first success run
+# bench.py (never timeout-killed — killing a client mid-compile wedges the
+# tunnel) so BENCH_TPU_SNAPSHOT.json captures a real-hardware record early.
+# Writes status lines to tools/tpu_watchdog.log.
+cd /root/repo
+LOG=tools/tpu_watchdog.log
+echo "$(date -u +%FT%TZ) watchdog start" >> "$LOG"
+for i in $(seq 1 200); do
+  if python -c "
+from maggy_tpu.util import backend_alive
+import sys
+sys.exit(0 if backend_alive(150) else 1)
+"; then
+    echo "$(date -u +%FT%TZ) tunnel ALIVE (probe $i); running bench" >> "$LOG"
+    python bench.py > tools/bench_early_r3.json 2> tools/bench_early_r3.err
+    echo "$(date -u +%FT%TZ) bench rc=$? done" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) probe $i dead; sleeping 420s" >> "$LOG"
+  sleep 420
+done
