@@ -23,6 +23,7 @@ class AblationConfig(LagomConfig):
         devices_per_trial: int = 1,
         optimization_key: str = "metric",
         log_dir: Optional[str] = None,
+        sharding: Optional[Any] = None,
     ):
         super().__init__(name, description, hb_interval)
         if direction not in ("max", "min"):
@@ -36,3 +37,4 @@ class AblationConfig(LagomConfig):
         self.devices_per_trial = int(devices_per_trial)
         self.optimization_key = optimization_key
         self.log_dir = log_dir
+        self.sharding = sharding

@@ -39,6 +39,7 @@ class HyperparameterOptConfig(LagomConfig):
         seed: Optional[int] = None,
         log_dir: Optional[str] = None,
         resume_from: Optional[str] = None,
+        sharding: Optional[Any] = None,
     ):
         """:param num_trials: total trials to run (pruner may override, as in the
             reference optimization_driver.py:88-93).
@@ -57,6 +58,9 @@ class HyperparameterOptConfig(LagomConfig):
         :param seed: RNG seed for samplers/surrogates.
         :param resume_from: path to a previous experiment directory; its
             finalized trials are preloaded and never re-run.
+        :param sharding: TrainContext preset ("dp", "fsdp", ...) or ShardingSpec
+            for the ``ctx`` injected into train_fns that ask for it; defaults
+            to "dp" over the trial's leased devices.
         """
         super().__init__(name, description, hb_interval)
         if not isinstance(num_trials, int) or num_trials <= 0:
@@ -84,3 +88,4 @@ class HyperparameterOptConfig(LagomConfig):
         self.seed = seed
         self.log_dir = log_dir
         self.resume_from = resume_from
+        self.sharding = sharding

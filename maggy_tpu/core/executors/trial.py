@@ -52,7 +52,9 @@ def trial_executor_fn(
         if "ctx" not in _ctx_cache:
             from maggy_tpu.train.trainer import TrainContext
 
-            _ctx_cache["ctx"] = TrainContext.create("dp", devices=devices or None)
+            # honor a sharding preset configured on the experiment; default dp
+            preset = getattr(config, "sharding", None) or "dp"
+            _ctx_cache["ctx"] = TrainContext.create(preset, devices=devices or None)
         return _ctx_cache["ctx"]
 
     def _executor() -> None:

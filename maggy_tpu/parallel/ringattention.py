@@ -15,6 +15,7 @@ schedule with blocks distributed over devices.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -92,15 +93,23 @@ def ring_attention(
         in-kernel via ``make_async_remote_copy`` and explicitly overlapped
         with the block compute, forward AND backward (the bwd ring rotates
         (k, v, dk, dv) together, recomputing probabilities from the saved
-        LSE). ``"auto"`` — pallas on TPU, xla elsewhere (the interpret
-        machine is for correctness tests, not speed).
+        LSE). ``"auto"`` — the XLA ring unless the mesh is on TPU *and*
+        ``MAGGY_TPU_RING_PALLAS=1`` is set: the RDMA kernel has not yet been
+        timed on real multi-chip ICI, so an untimed kernel is never the
+        silent default training path (it stays one env var away, and the
+        ``bench.py`` ring microbench records the comparison when hardware
+        allows).
     :param interpret: pallas only — run under the TPU interpret machine
         (defaults to True off-TPU so CPU meshes can test the kernel).
     """
     if segment_ids is not None:
         raise NotImplementedError("ring attention does not support segment_ids yet")
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        # resolve from the mesh's devices, not the process default backend —
+        # a CPU mesh created on a TPU-capable host must not pick pallas
+        on_tpu = mesh.devices.flat[0].platform == "tpu"
+        opt_in = os.environ.get("MAGGY_TPU_RING_PALLAS") == "1"
+        impl = "pallas" if (on_tpu and opt_in) else "xla"
     if impl not in ("xla", "pallas"):
         raise ValueError(f"impl must be 'xla', 'pallas', or 'auto', got {impl!r}")
     num_shards = mesh.shape[axis_name]
@@ -137,7 +146,7 @@ def _pallas_ring(q, k, v, *, mesh, causal, axis_name, interpret):
     from maggy_tpu.ops.ring_flash import ring_flash_attention
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = mesh.devices.flat[0].platform != "tpu"
     # the kernel carries its own custom_vjp (ring backward with rotating
     # dk/dv accumulators) — nothing to wrap here
     return ring_flash_attention(
@@ -148,8 +157,9 @@ def _pallas_ring(q, k, v, *, mesh, causal, axis_name, interpret):
 
 def make_ring_attention(mesh, axis_name: str = AXIS_SEQ, impl: str = "auto"):
     """Build an ``attention_fn`` for DecoderConfig: same signature as
-    ``default_attention``. ``impl="auto"`` trains through the RDMA Pallas
-    kernel (fwd+bwd) on TPU and the ppermute ring elsewhere."""
+    ``default_attention``. ``impl="auto"`` trains through the XLA ppermute
+    ring; set ``MAGGY_TPU_RING_PALLAS=1`` on a TPU mesh to opt into the RDMA
+    Pallas kernel (fwd+bwd) once it has a recorded win on real ICI."""
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
         return ring_attention(

@@ -54,11 +54,11 @@ def test_ring_flash_gqa_matches_xla_ring():
     assert float(jnp.abs(pallas - xla).max()) < 2e-5
 
 
-@pytest.mark.slow
 def test_ring_flash_backward_kernel_parity():
     """The RDMA backward ring (rotating dk/dv accumulators, probabilities
     recomputed from the saved LSE) must give the same gradients as the
-    differentiable XLA ppermute ring."""
+    differentiable XLA ppermute ring. Kept in the fast tier (small 2-device
+    S=32 case) so 'not slow' still catches backward-kernel regressions."""
     mesh = _mesh(2)
     q, k, v = _qkv(B=1, S=32, H=2, KH=2, D=8)
 
@@ -77,6 +77,24 @@ def test_ring_flash_backward_kernel_parity():
         gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gp, gx):
         assert float(jnp.abs(a - b).max()) < 5e-5
+
+
+def test_auto_impl_gates_pallas_off_tpu(monkeypatch):
+    """impl='auto' must resolve from the mesh's device platform and the
+    MAGGY_TPU_RING_PALLAS opt-in: on a CPU mesh it always takes the XLA ring,
+    even with the opt-in set (ADVICE r3; VERDICT r3 item 6)."""
+    from maggy_tpu.parallel import ringattention as ra
+
+    def boom(*a, **k):
+        raise AssertionError("pallas path selected on a CPU mesh")
+
+    monkeypatch.setattr(ra, "_pallas_ring", boom)
+    monkeypatch.setenv("MAGGY_TPU_RING_PALLAS", "1")
+    mesh = _mesh(2)
+    q, k, v = _qkv(B=1, S=32, H=2, KH=2, D=8)
+    with jax.set_mesh(mesh):
+        out = ring_attention(q, k, v, mesh=mesh, causal=True, impl="auto")
+    assert out.shape == q.shape
 
 
 @pytest.mark.slow

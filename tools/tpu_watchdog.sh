@@ -2,7 +2,7 @@
 # TPU tunnel watchdog: probe liveness every ~7 min; on first success run
 # bench.py (never timeout-killed — killing a client mid-compile wedges the
 # tunnel) so BENCH_TPU_SNAPSHOT.json captures a real-hardware record early.
-# Writes status lines to tools/tpu_watchdog.log.
+# Writes status lines to tools/tpu_watchdog.log (gitignored).
 cd /root/repo
 LOG=tools/tpu_watchdog.log
 echo "$(date -u +%FT%TZ) watchdog start" >> "$LOG"
@@ -13,7 +13,7 @@ import sys
 sys.exit(0 if backend_alive(150) else 1)
 "; then
     echo "$(date -u +%FT%TZ) tunnel ALIVE (probe $i); running bench" >> "$LOG"
-    python bench.py > tools/bench_early_r3.json 2> tools/bench_early_r3.err
+    python bench.py > tools/bench_early_r4.json 2> tools/bench_early_r4.err
     echo "$(date -u +%FT%TZ) bench rc=$? done" >> "$LOG"
     exit 0
   fi
