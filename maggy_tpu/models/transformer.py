@@ -72,6 +72,11 @@ class DecoderConfig:
     # decode=True switches attention to the KV-cache incremental path
     # (build via `dataclasses.replace(cfg, decode=True)`; params are identical)
     decode: bool = False
+    # False drops the nn.with_partitioning logical-axis annotations from every
+    # param (identical values/tree). Used where params are placed manually —
+    # e.g. per-stage modules inside the pipeline shard_map, where flax would
+    # otherwise try to resolve logical names against the physical mesh
+    partition_params: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -129,15 +134,21 @@ class DecoderConfig:
         )
 
 
+def _partitioned(init, logical_axes, cfg):
+    # getattr: _dense/RMSNorm are shared by model configs (Bert, MoE, ...)
+    # that don't carry the pipeline-only partition_params switch
+    if getattr(cfg, "partition_params", True):
+        return nn.with_partitioning(init, logical_axes)
+    return init
+
+
 def _dense(features, logical_axes, cfg: DecoderConfig, name: str):
     return nn.DenseGeneral(
         features=features,
         use_bias=False,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
-        kernel_init=nn.with_partitioning(
-            nn.initializers.normal(stddev=0.02), logical_axes
-        ),
+        kernel_init=_partitioned(nn.initializers.normal(stddev=0.02), logical_axes, cfg),
         name=name,
     )
 
@@ -149,7 +160,7 @@ class RMSNorm(nn.Module):
     def __call__(self, x):
         scale = self.param(
             "scale",
-            nn.with_partitioning(nn.initializers.ones_init(), ("norm",)),
+            _partitioned(nn.initializers.ones_init(), ("norm",), self.cfg),
             (x.shape[-1],),
             self.cfg.param_dtype,
         )
@@ -262,8 +273,8 @@ class Attention(nn.Module):
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_partitioning(
-                nn.initializers.normal(stddev=0.02), ("heads", None, "embed")
+            kernel_init=_partitioned(
+                nn.initializers.normal(stddev=0.02), ("heads", None, "embed"), cfg
             ),
             name="wo",
         )(out)
@@ -372,8 +383,8 @@ class Decoder(nn.Module):
             )
         embed = self.param(
             "embedding",
-            nn.with_partitioning(
-                nn.initializers.normal(stddev=1.0), ("vocab", "embed")
+            _partitioned(
+                nn.initializers.normal(stddev=1.0), ("vocab", "embed"), cfg
             ),
             (cfg.vocab_size, cfg.d_model),
             cfg.param_dtype,

@@ -29,7 +29,7 @@ Two schedules:
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -143,32 +143,48 @@ def pipeline_grads_1f1b(
     *,
     mesh,
     axis_name: str = AXIS_STAGE,
+    first_fn: Optional[Callable] = None,
 ):
     """One training step with the 1F1B schedule: returns ``(loss, grads)``.
 
     :param stage_fn: ``fn(params_for_one_stage, x) -> y``, activation-shape and
         dtype preserving.
-    :param loss_fn: ``fn(y_final, target) -> scalar`` — mean loss of ONE
-        microbatch (computed on the last stage only; no output buffer ever
-        forms, let alone gets broadcast).
+    :param loss_fn: ``fn(params_for_one_stage, y_final, target) -> scalar`` —
+        mean loss of ONE microbatch, computed on the last stage only (no
+        output buffer ever forms, let alone gets broadcast). Taking the stage
+        params lets a language-model head (final norm + lm_head) live inside
+        the loss so its gradients flow on the last stage.
     :param stage_params: leaves ``[n_stages, ...]`` (see
-        :func:`stack_stage_params`).
+        :func:`stack_stage_params`). The tree must be UNIFORM across stages;
+        params used by one stage only (embedding on stage 0, head on the last)
+        simply receive zero gradient contributions elsewhere.
     :param microbatches: ``[n_micro, mb, ...]``; ``targets`` any pytree of
         ``[n_micro, ...]`` leaves consumed by ``loss_fn``.
+    :param first_fn: optional ``fn(params_for_one_stage, raw_microbatch) -> x``
+        applied by stage 0 to turn a raw microbatch (e.g. int token ids) into
+        the pipeline's activation dtype/shape — the embedding lookup of a
+        language model. Differentiated together with stage 0's chunk, so
+        embedding gradients come out in stage 0's param grads. When None the
+        microbatches themselves must already be activations.
     :returns: ``loss`` — mean over all microbatches (replicated), and
         ``grads`` — same structure/sharding as ``stage_params``.
 
     Memory: each stage stores its in-flight stage inputs in an (S+1)-slot
     ring and re-linearises (recompute + VJP) at its backward tick — O(S)
     activations per stage versus GPipe-autodiff's O(ticks) scan residuals.
+    With ``first_fn``, the ring stores raw-microbatch-derived activations for
+    stage 0 implicitly: stage 0 re-reads the (cheap, int) microbatch stream at
+    backward time and recomputes the embedding inside its VJP.
     """
+    if first_fn is None:
+        first_fn = lambda params, raw: raw  # noqa: E731 - identity ingest
     S = mesh.shape[axis_name]
     M = microbatches.shape[0]
     if S == 1:
         def loss_all(params):
             p0 = jax.tree.map(lambda q: q[0], params)
             losses = jax.vmap(
-                lambda x, t: loss_fn(stage_fn(p0, x), t)
+                lambda x, t: loss_fn(p0, stage_fn(p0, first_fn(p0, x)), t)
             )(microbatches, targets)
             return losses.mean()
 
@@ -186,8 +202,20 @@ def pipeline_grads_1f1b(
         is_last = stage == S - 1
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
         bwd_perm = [(i + 1, i) for i in range(S - 1)]
-        zeros_mb = jnp.zeros(mbs.shape[1:], mbs.dtype)
+        # activation shape/dtype comes from first_fn's output, not the raw
+        # microbatch stream (they differ when first_fn embeds token ids)
+        act = jax.eval_shape(
+            first_fn, params, jax.ShapeDtypeStruct(mbs.shape[1:], mbs.dtype)
+        )
+        zeros_mb = jnp.zeros(act.shape, act.dtype)
         zero_dp = jax.tree.map(jnp.zeros_like, params)
+
+        def ingest(p, raw, x_ring):
+            """Stage 0 turns the raw microbatch into an activation; everyone
+            else reads the ring. Both branches are computed and where-selected
+            (first_fn is a cheap gather), which keeps the select differentiable
+            so embedding grads appear exactly on stage 0."""
+            return jnp.where(stage == 0, first_fn(p, raw), x_ring)
 
         def fwd_micro(t, s):
             """Which microbatch (if any) stage s forwards at tick t."""
@@ -205,12 +233,6 @@ def pipeline_grads_1f1b(
         def bwd_micro(t, s):
             tb = t - (2 * S - 1 - s)
             return tb // 2, (tb >= 0) & (tb % 2 == 0) & (tb // 2 < M)
-
-        def pick(buf, mbs_idx, ring_idx):
-            """stage 0 reads the microbatch stream; others read the ring."""
-            from_mbs = jax.lax.dynamic_index_in_dim(mbs, mbs_idx, keepdims=False)
-            from_ring = jax.lax.dynamic_index_in_dim(buf, ring_idx, keepdims=False)
-            return jnp.where(stage == 0, from_mbs, from_ring)
 
         def tick(carry, t):
             xbuf, y_recv, g_recv, grad_acc, loss_acc = carry
@@ -231,42 +253,48 @@ def pipeline_grads_1f1b(
             m_f, do_f = fwd_micro(t, stage)
             do_f = do_f & ~is_last
             mf = jnp.clip(m_f, 0, M - 1)
-            x_in = pick(xbuf, mf, mf % RING)
+            raw_f = jax.lax.dynamic_index_in_dim(mbs, mf, keepdims=False)
+            ring_f = jax.lax.dynamic_index_in_dim(xbuf, mf % RING, keepdims=False)
             y = jax.lax.cond(
                 do_f,
-                lambda x: stage_fn(params, x),
-                lambda x: jnp.zeros_like(x),
-                x_in,
+                lambda raw, xr: stage_fn(params, ingest(params, raw, xr)),
+                lambda raw, xr: zeros_mb,
+                raw_f, ring_f,
             )
 
-            # 3. backward op: re-linearise from the saved stage input
+            # 3. backward op: re-linearise from the saved stage input (stage 0
+            # re-reads the raw microbatch stream and re-embeds inside its VJP)
             m_b, do_b = bwd_micro(t, stage)
             mb_ = jnp.clip(m_b, 0, M - 1)
-            x_sv = pick(xbuf, mb_, mb_ % RING)
+            raw_b = jax.lax.dynamic_index_in_dim(mbs, mb_, keepdims=False)
+            ring_b = jax.lax.dynamic_index_in_dim(xbuf, mb_ % RING, keepdims=False)
             tgt = jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(a, mb_, keepdims=False),
                 tgts,
             )
 
-            def run_bwd(x, g):
-                def last_fn(x, g):
+            def run_bwd(raw, xr, g):
+                def last_fn(raw, xr, g):
                     lval, pull = jax.vjp(
-                        lambda p, xx: loss_fn(stage_fn(p, xx), tgt), params, x
+                        lambda p, x: loss_fn(p, stage_fn(p, ingest(p, raw, x)), tgt),
+                        params, xr,
                     )
                     dp, dx = pull(jnp.ones_like(lval))
                     return dp, dx, lval.astype(jnp.float32)
 
-                def mid_fn(x, g):
-                    yv, pull = jax.vjp(stage_fn, params, x)
+                def mid_fn(raw, xr, g):
+                    yv, pull = jax.vjp(
+                        lambda p, x: stage_fn(p, ingest(p, raw, x)), params, xr
+                    )
                     dp, dx = pull(g.astype(yv.dtype))
                     return dp, dx, jnp.float32(0)
 
-                return jax.lax.cond(is_last, last_fn, mid_fn, x, g)
+                return jax.lax.cond(is_last, last_fn, mid_fn, raw, xr, g)
 
-            def skip_bwd(x, g):
+            def skip_bwd(raw, xr, g):
                 return zero_dp, zeros_mb, jnp.float32(0)
 
-            dp, dx, lval = jax.lax.cond(do_b, run_bwd, skip_bwd, x_sv, g_recv)
+            dp, dx, lval = jax.lax.cond(do_b, run_bwd, skip_bwd, raw_b, ring_b, g_recv)
             grad_acc = jax.tree.map(lambda a, d: a + d, grad_acc, dp)
             loss_acc = loss_acc + lval
 
@@ -276,7 +304,7 @@ def pipeline_grads_1f1b(
             return (xbuf, y_next, g_next, grad_acc, loss_acc), None
 
         init = (
-            jnp.zeros((RING,) + mbs.shape[1:], mbs.dtype),
+            jnp.zeros((RING,) + act.shape, act.dtype),
             zeros_mb,
             zeros_mb,
             zero_dp,

@@ -153,6 +153,16 @@ def constrain_activation(x, logical_axes, rules=DEFAULT_RULES):
     mesh = ambient_mesh()
     if mesh is None or mesh.size == 1:
         return x
+    try:
+        # inside a shard_map body (Manual axes) placement is already manual;
+        # a constraint built from the Auto physical mesh would trace without
+        # raising but poison the region's vjp with a mesh-mismatched op
+        am = jax.sharding.get_abstract_mesh()
+        manual = getattr(jax.sharding.AxisType, "Manual", None)
+        if not am.empty and manual is not None and manual in set(am.axis_types):
+            return x
+    except Exception:
+        pass
     axes = list(logical_to_mesh_axes(logical_axes, rules))
     for i, axis in enumerate(axes):
         ext = mesh_extent(mesh, axis)

@@ -29,7 +29,7 @@ import optax
 from flax.training import train_state
 
 from maggy_tpu.parallel import sharding as shd
-from maggy_tpu.parallel.spec import ShardingSpec
+from maggy_tpu.parallel.spec import AXIS_EXPERT, AXIS_SEQ, AXIS_STAGE, AXIS_TENSOR, ShardingSpec
 
 
 class TrainState(train_state.TrainState):
@@ -77,18 +77,50 @@ class Trainer:
     loss_fn: Callable = lm_loss_fn
     rules: Tuple = shd.DEFAULT_RULES
     rngs_in_apply: bool = False
+    # pipeline parallelism: microbatches per step when the mesh has a stage
+    # axis > 1 (defaults to 2*pp — enough to amortize the 1F1B bubble while
+    # staying valid for small test batches); must divide the batch size
+    n_microbatches: Optional[int] = None
 
     def __post_init__(self):
         self._train_step = None
         self._eval_step = None
         self._eval_loss_step = None
         self.state_shardings = None
+        self._pp_parts = None
+        self._pp_built_micro = None
+
+    # ---------------------------------------------------------------- pipeline
+
+    @property
+    def pp(self) -> int:
+        """Pipeline stages = the mesh's ``stage`` axis extent (1 = off)."""
+        return dict(self.mesh.shape).get(AXIS_STAGE, 1)
+
+    def _pipeline_parts(self):
+        if self._pp_parts is None:
+            from maggy_tpu.train.pipeline_adapter import decoder_pipeline_parts
+
+            shape = dict(self.mesh.shape)
+            bad = [a for a in (AXIS_TENSOR, AXIS_SEQ, AXIS_EXPERT) if shape.get(a, 1) > 1]
+            if bad:
+                raise ValueError(
+                    f"pp>1 composes with dp/fsdp only; mesh also has {bad} > 1. "
+                    "Stage params are placed P('stage') — a tensor/seq/expert "
+                    "axis would silently replicate (VERDICT r3 item 2)."
+                )
+            self._pp_parts = decoder_pipeline_parts(self.model, self.pp)
+        return self._pp_parts
 
     # ------------------------------------------------------------------ state
 
     def make_state(self, rng: jax.Array, sample_batch: Dict[str, Any]) -> TrainState:
         """Initialize a TrainState with every leaf born on its target devices
-        (jit + out_shardings — no host-side full materialization)."""
+        (jit + out_shardings — no host-side full materialization). Under a
+        ``stage`` mesh axis > 1 the params are born in the stage-stacked
+        pipeline layout (see :mod:`maggy_tpu.train.pipeline_adapter`)."""
+        if self.pp > 1:
+            return self._make_state_pp(rng, sample_batch)
         inputs = _model_inputs(sample_batch)
 
         def init_fn(rng, *ins):
@@ -104,6 +136,37 @@ class Trainer:
 
         # np (not jnp): host values enter a multi-process jit as replicated
         # inputs instead of arrays committed to one process's local device
+        with self.mesh:
+            return init(rng, *jax.tree.map(np.asarray, inputs))
+
+    def _make_state_pp(self, rng: jax.Array, sample_batch: Dict[str, Any]) -> TrainState:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        parts = self._pipeline_parts()
+        inputs = _model_inputs(sample_batch)
+
+        def init_fn(rng, *ins):
+            variables = self.model.init(rng, *ins)
+            stage_params = parts.restack(shd.unbox(variables["params"]))
+            return TrainState.create(
+                apply_fn=self.model.apply, params=stage_params, tx=self.optimizer
+            )
+
+        abstract = jax.eval_shape(init_fn, rng, *inputs)
+
+        def shard_of(leaf):
+            # every stage-stacked leaf (params and the optax state mirroring
+            # them) leads with [n_stages]; the rest (step / adam count) are
+            # scalars — so leading-dim == pp is exact here, not a heuristic
+            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == parts.n_stages:
+                return NamedSharding(self.mesh, P(AXIS_STAGE))
+            return NamedSharding(self.mesh, P())
+
+        self.state_shardings = jax.tree.map(shard_of, abstract)
+        init = jax.jit(init_fn, out_shardings=self.state_shardings)
+        import numpy as np
+
         with self.mesh:
             return init(rng, *jax.tree.map(np.asarray, inputs))
 
@@ -143,7 +206,88 @@ class Trainer:
 
     # ------------------------------------------------------------------ steps
 
+    def _build_pp_train_step(self):
+        """1F1B pipeline training step (mesh has stage>1): microbatch the
+        batch axis, run :func:`pipeline_grads_1f1b` with the Decoder stage
+        adapter, apply gradients in the stage-stacked layout.
+
+        Loss semantics: the schedule averages per-microbatch losses. For the
+        built-in :func:`lm_loss_fn` with a ``loss_mask`` that would differ
+        from the dense path's global mask-weighted mean (sparse microbatches
+        would be up-weighted), so that case is rescaled to the exact global
+        mean. Custom loss_fns keep plain microbatch-mean averaging.
+        """
+        from maggy_tpu.parallel.pipeline import pipeline_grads_1f1b
+
+        parts = self._pipeline_parts()
+        n_micro = self.n_microbatches or 2 * parts.n_stages
+        self._pp_built_micro = n_micro
+        shape = dict(self.mesh.shape)
+        dpf = shape.get(shd.AXIS_DATA, 1) * shape.get(shd.AXIS_FSDP, 1)
+
+        def train_step(state: TrainState, batch):
+            tokens = _model_inputs(batch)[0]
+            bsz = tokens.shape[0]
+            if bsz % n_micro:
+                raise ValueError(
+                    f"batch size {bsz} not divisible by n_microbatches "
+                    f"{n_micro}; set Trainer(n_microbatches=...) to a divisor"
+                )
+            if (bsz // n_micro) % dpf:
+                raise ValueError(
+                    f"each of the {n_micro} microbatches has {bsz // n_micro} "
+                    f"rows, which must divide the mesh's data x fsdp extent "
+                    f"({dpf}); grow the batch or lower n_microbatches"
+                )
+
+            def split(a):
+                return a.reshape((n_micro, bsz // n_micro) + a.shape[1:])
+
+            tgts = jax.tree.map(split, batch)
+            mask_norm = None
+            if self.loss_fn is lm_loss_fn and isinstance(batch, dict) and "loss_mask" in batch:
+                # global mask sum, for rescaling per-microbatch masked means
+                # back to the dense objective (docstring above)
+                mask_norm = jnp.maximum(
+                    batch["loss_mask"][:, 1:].astype(jnp.float32).sum(), 1.0
+                )
+
+            def loss_pp(stage_params, y, tgt):
+                loss = self.loss_fn(parts.head_fn(stage_params, y), tgt)
+                if mask_norm is not None:
+                    local = jnp.maximum(
+                        tgt["loss_mask"][:, 1:].astype(jnp.float32).sum(), 1.0
+                    )
+                    # primitive divides the psum of these by dpf*n_micro;
+                    # this rescale makes the total sum(ll*mask)/global_sum
+                    loss = loss * local * (dpf * n_micro) / mask_norm
+                return loss
+
+            loss, grads = pipeline_grads_1f1b(
+                parts.stage_fn,
+                loss_pp,
+                state.params,
+                split(tokens),
+                tgts,
+                mesh=self.mesh,
+                first_fn=parts.first_fn,
+            )
+            new_state = state.apply_gradients(grads=grads)
+            zero = jnp.zeros((), jnp.float32)
+            return new_state, {
+                "loss": loss,
+                "aux_loss": zero,
+                "total_loss": loss,
+                "grad_norm": optax.global_norm(grads),
+                "step": state.step,
+            }
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
     def _build_train_step(self):
+        if self.pp > 1:
+            return self._build_pp_train_step()
+
         def train_step(state: TrainState, batch):
             def loss_of(params):
                 # mutable intermediates so modules can sow auxiliary losses
@@ -177,6 +321,12 @@ class Trainer:
         return jax.jit(train_step, donate_argnums=(0,))
 
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        if (
+            self._train_step is not None
+            and self.pp > 1
+            and (self.n_microbatches or 2 * self.pp) != self._pp_built_micro
+        ):
+            self._train_step = None  # n_microbatches changed: recompile
         if self._train_step is None:
             self._train_step = self._build_train_step()
         with self.mesh:
@@ -184,8 +334,17 @@ class Trainer:
 
     def eval_logits(self, state: TrainState, batch):
         if self._eval_step is None:
-            def eval_step(state, batch):
-                return state.apply_fn({"params": state.params}, *_model_inputs(batch))
+            if self.pp > 1:
+                # stage-stacked params don't fit model.apply; run the full
+                # (unstacked) model replicated — eval is occasional and small
+                parts = self._pipeline_parts()
+
+                def eval_step(state, batch):
+                    params = parts.unstack(state.params)
+                    return self.model.apply({"params": params}, *_model_inputs(batch))
+            else:
+                def eval_step(state, batch):
+                    return state.apply_fn({"params": state.params}, *_model_inputs(batch))
 
             self._eval_step = jax.jit(eval_step)
         with self.mesh:
@@ -197,9 +356,17 @@ class Trainer:
         if num_batches < 1:
             raise ValueError("evaluate needs num_batches >= 1")
         if self._eval_loss_step is None:
-            def eval_loss(state, batch):
-                logits = state.apply_fn({"params": state.params}, *_model_inputs(batch))
-                return self.loss_fn(logits, batch)
+            if self.pp > 1:
+                parts = self._pipeline_parts()
+
+                def eval_loss(state, batch):
+                    params = parts.unstack(state.params)
+                    logits = self.model.apply({"params": params}, *_model_inputs(batch))
+                    return self.loss_fn(logits, batch)
+            else:
+                def eval_loss(state, batch):
+                    logits = state.apply_fn({"params": state.params}, *_model_inputs(batch))
+                    return self.loss_fn(logits, batch)
 
             self._eval_loss_step = jax.jit(eval_loss)
         losses = []
@@ -304,8 +471,21 @@ class TrainContext:
             role=role,
         )
 
-    def trainer(self, model, optimizer, loss_fn: Callable = lm_loss_fn) -> Trainer:
-        return Trainer(model, optimizer, self.mesh, loss_fn=loss_fn, rules=self.rules)
+    def trainer(
+        self,
+        model,
+        optimizer,
+        loss_fn: Callable = lm_loss_fn,
+        n_microbatches: Optional[int] = None,
+    ) -> Trainer:
+        return Trainer(
+            model,
+            optimizer,
+            self.mesh,
+            loss_fn=loss_fn,
+            rules=self.rules,
+            n_microbatches=n_microbatches,
+        )
 
     def shard(self, tree, logical_axes=("batch",)):
         target = shd.named_sharding(self.mesh, logical_axes, self.rules)

@@ -116,13 +116,13 @@ def test_1f1b_matches_gpipe_autodiff(n_stages, n_micro):
     rng = jax.random.key(42)
     targets = jax.random.normal(rng, x.shape)
 
-    def loss_fn(y, t):
+    def loss_fn(p, y, t):
         return ((y - t) ** 2).mean()
 
     def gpipe_loss(w):
         with mesh:
             outs = pipeline_apply(stage_fn, w, x, mesh=mesh)
-        return jax.vmap(loss_fn)(outs, targets).mean()
+        return jax.vmap(lambda y, t: ((y - t) ** 2).mean())(outs, targets).mean()
 
     ref_loss, ref_grads = jax.value_and_grad(gpipe_loss)(stage_w)
 
@@ -142,7 +142,7 @@ def test_1f1b_single_stage_path():
     stage_w = stack_stage_params(weights, 1)
     targets = jnp.zeros_like(x)
 
-    def loss_fn(y, t):
+    def loss_fn(p, y, t):
         return ((y - t) ** 2).mean()
 
     with mesh:

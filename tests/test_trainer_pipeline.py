@@ -1,0 +1,174 @@
+"""Trainer-integrated pipeline parallelism (VERDICT r3 item 2): a mesh with
+stage>1 must actually train the real Decoder under 1F1B — same numbers as the
+dense path — or raise loudly, never silently replicate the stage axis."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.parallel.spec import ShardingSpec
+from maggy_tpu.train import TrainContext
+from maggy_tpu.train.trainer import lm_loss_fn
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh"
+)
+
+
+def _batch(cfg, bsz=8, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"tokens": rng.integers(0, cfg.vocab_size, (bsz, seq)).astype(np.int32)}
+
+
+def test_pp_trainer_matches_dense_loss_and_grads():
+    """pp=2 1F1B step == dense jax.grad on the same params (loss and grads,
+    compared through unstack)."""
+    cfg = DecoderConfig.tiny()
+    batch = _batch(cfg)
+
+    ctx = TrainContext.create(ShardingSpec(pp=2, dp=4))
+    trainer = ctx.trainer(Decoder(cfg), optax.sgd(1e-2))
+    trainer.n_microbatches = 2
+    state = trainer.make_state(jax.random.key(0), batch)
+
+    parts = trainer._pipeline_parts()
+    dense_params = jax.device_get(jax.jit(parts.unstack)(state.params))
+
+    model = Decoder(cfg)
+
+    def dense_loss(params):
+        return lm_loss_fn(model.apply({"params": params}, batch["tokens"]), batch)
+
+    ref_loss, ref_grads = jax.value_and_grad(dense_loss)(dense_params)
+
+    new_state, metrics = trainer.step(state, trainer.shard_batch(batch))
+    assert abs(float(metrics["loss"]) - float(ref_loss)) < 2e-3
+
+    # grads: recover from the sgd update (p_new = p - lr * g)
+    got = jax.jit(parts.unstack)(new_state.params)
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_grads)
+    flat_old = dict(jax.tree_util.tree_leaves_with_path(dense_params))
+    flat_new = dict(jax.tree_util.tree_leaves_with_path(jax.device_get(got)))
+    for path, g_ref in flat_ref:
+        g_got = (flat_old[path] - flat_new[path]) / 1e-2
+        np.testing.assert_allclose(
+            np.asarray(g_got), np.asarray(g_ref), atol=5e-2,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pp_trainer_loss_decreases_and_eval_matches():
+    cfg = DecoderConfig.tiny()
+    batch = _batch(cfg)
+    ctx = TrainContext.create(ShardingSpec(pp=2, dp=4))
+    trainer = ctx.trainer(Decoder(cfg), optax.adamw(1e-2))
+    trainer.n_microbatches = 2
+    state = trainer.make_state(jax.random.key(0), batch)
+    losses = []
+    for _ in range(5):
+        state, m = trainer.step(state, trainer.shard_batch(batch))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+    # eval path under pp: unstacked apply equals the stage-stacked state
+    parts = trainer._pipeline_parts()
+    dense_params = jax.jit(parts.unstack)(state.params)
+    ref = Decoder(cfg).apply({"params": dense_params}, jnp.asarray(batch["tokens"]))
+    got = trainer.eval_logits(state, trainer.shard_batch(batch))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(jax.device_get(ref)), atol=1e-4
+    )
+
+
+def test_pp_four_stages():
+    """4 stages x 2 dp on the deeper tiny config; restack round-trips."""
+    cfg = DecoderConfig.tiny(n_layers=4)
+    batch = _batch(cfg, bsz=8)
+    ctx = TrainContext.create(ShardingSpec(pp=4, dp=2))
+    trainer = ctx.trainer(Decoder(cfg), optax.adamw(1e-2))
+    trainer.n_microbatches = 4
+    state = trainer.make_state(jax.random.key(1), batch)
+    state, m = trainer.step(state, trainer.shard_batch(batch))
+    assert np.isfinite(float(m["loss"]))
+
+    parts = trainer._pipeline_parts()
+    stacked = jax.jit(parts.restack)(jax.jit(parts.unstack)(state.params))
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(jax.device_get(stacked)),
+        jax.tree_util.tree_leaves_with_path(jax.device_get(state.params)),
+    ):
+        assert pa == pb
+        if "embedding" in jax.tree_util.keystr(pa) or "final_norm" in jax.tree_util.keystr(pa) or "lm_head" in jax.tree_util.keystr(pa):
+            continue  # broadcast leaves only round-trip their owning stage
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+
+def test_pp_loss_mask_matches_dense_weighting():
+    """Uneven loss_mask density across microbatches: the pp step must report
+    the dense path's global mask-weighted mean, not an average of
+    per-microbatch masked means (which would up-weight sparse microbatches)."""
+    cfg = DecoderConfig.tiny()
+    batch = _batch(cfg)
+    mask = np.zeros_like(batch["tokens"])
+    mask[:2] = 1          # dense rows in microbatch 0
+    mask[2:, :3] = 1      # sparse rows elsewhere
+    batch["loss_mask"] = mask
+
+    ctx = TrainContext.create(ShardingSpec(pp=2, dp=4))
+    trainer = ctx.trainer(Decoder(cfg), optax.sgd(1e-2), n_microbatches=2)
+    state = trainer.make_state(jax.random.key(0), batch)
+    parts = trainer._pipeline_parts()
+    dense_params = jax.device_get(jax.jit(parts.unstack)(state.params))
+    ref = lm_loss_fn(
+        Decoder(cfg).apply({"params": dense_params}, jnp.asarray(batch["tokens"])),
+        {k: jnp.asarray(v) for k, v in batch.items()},
+    )
+    _, metrics = trainer.step(state, trainer.shard_batch(batch))
+    assert abs(float(metrics["loss"]) - float(ref)) < 2e-3
+
+
+def test_pp_raises_loudly_for_unsupported():
+    import flax.linen as nn
+
+    class NotADecoder(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    cfg = DecoderConfig.tiny()
+    batch = _batch(cfg)
+
+    ctx = TrainContext.create(ShardingSpec(pp=2, dp=4))
+    trainer = ctx.trainer(NotADecoder(), optax.sgd(1e-2))
+    with pytest.raises(ValueError, match="Decoder"):
+        trainer.make_state(jax.random.key(0), {"inputs": np.zeros((8, 4), np.float32)})
+
+    # pp x tp would silently replicate stage params over tensor: refuse
+    ctx2 = TrainContext.create(ShardingSpec(pp=2, dp=2, tp=2))
+    tr2 = ctx2.trainer(Decoder(cfg), optax.sgd(1e-2))
+    with pytest.raises(ValueError, match="dp/fsdp"):
+        tr2.make_state(jax.random.key(0), batch)
+
+    # layer count must split evenly into stages
+    ctx3 = TrainContext.create(ShardingSpec(pp=4, dp=2))
+    tr3 = ctx3.trainer(Decoder(DecoderConfig.tiny(n_layers=2)), optax.sgd(1e-2))
+    with pytest.raises(ValueError, match="divisible"):
+        tr3.make_state(jax.random.key(0), batch)
+
+    # tied embeddings would silently untie across stages
+    ctx4 = TrainContext.create(ShardingSpec(pp=2, dp=4))
+    tr4 = ctx4.trainer(
+        Decoder(DecoderConfig.tiny(tie_embeddings=True)), optax.sgd(1e-2)
+    )
+    with pytest.raises(ValueError, match="tie_embeddings"):
+        tr4.make_state(jax.random.key(0), batch)
+
+    # microbatch rows must shard over data x fsdp: clear error, not shard_map's
+    ctx5 = TrainContext.create(ShardingSpec(pp=2, dp=4))
+    tr5 = ctx5.trainer(Decoder(cfg), optax.sgd(1e-2), n_microbatches=4)
+    state5 = tr5.make_state(jax.random.key(0), batch)  # bsz=8 -> mb=2 < dpf=4
+    with pytest.raises(ValueError, match="microbatches"):
+        tr5.step(state5, tr5.shard_batch(batch))
