@@ -1,7 +1,11 @@
 """BASELINE config 4 (scaled down): BERT component ablation study.
 
 LOCO over encoder layers + the pooler: one baseline trial, one trial per
-ablated component, ranked by downstream accuracy.
+ablated component, ranked by downstream accuracy. ZERO factories (reference
+parity, loco.py:82-136): the driver derives each ablated variant from the
+config model automatically — BertConfig carries an ``ablated`` field, so the
+model is rebuilt with the component dropped; models without one get generic
+param-subtree masking.
 
     python examples/bert_ablation.py
 """
@@ -14,8 +18,6 @@ sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")
 from maggy_tpu.util import pin_cpu_if_requested
 
 pin_cpu_if_requested()
-
-import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -56,12 +58,14 @@ def train(model, reporter):
 if __name__ == "__main__":
     study = AblationStudy()
     study.model.layers.include("layer_0", "layer_1", "pooler")
-    study.model.set_factory(
-        lambda ablated: Bert(dataclasses.replace(CFG, ablated=ablated))
-    )
     result = experiment.lagom(
         train,
-        AblationConfig(ablation_study=study, direction="max", hb_interval=0.2),
+        AblationConfig(
+            ablation_study=study,
+            model=Bert(CFG),  # no set_factory: variants derived automatically
+            direction="max",
+            hb_interval=0.2,
+        ),
     )
     print("trials:", result["num_trials"])
     print("best variant:", result["best"]["params"], result["best"]["metric"])

@@ -6,12 +6,14 @@ Capability parity with the reference ``maggy/ablation/ablationstudy.py:18-408``:
 components (single names, or groups ablated together), and custom model
 generators cover anything declarative names cannot.
 
-Model surgery is flax-idiomatic: instead of editing a Keras config JSON
-(reference loco.py:82-136 removes layers from ``model.to_json()``), the study
-carries a **model factory** ``fn(ablated: frozenset[str]) -> flax module`` and
-each trial calls it with the component set to drop. Our model families accept
-this pattern naturally (a frozen config dataclass → module); any user model can
-opt in with a two-line factory.
+Model surgery is flax-idiomatic and **factory-free by default** (matching the
+reference's zero-plumbing Keras-JSON surgery, loco.py:82-136): when the study
+has no factory the driver derives each variant from the config model via
+:func:`maggy_tpu.ablation.masking.auto_ablate` — ``cfg.without(components)``
+for config-driven families (Decoder), an ``ablated`` config field rebuild
+(Bert), or generic param-subtree zero-masking for any other flax module. A
+**model factory** ``fn(ablated: frozenset[str]) -> flax module`` remains the
+escape hatch for fully custom surgery.
 """
 
 from __future__ import annotations

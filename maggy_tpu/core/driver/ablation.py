@@ -114,9 +114,22 @@ class AblationDriver(HyperparameterOptDriver):
                 )
                 available["model"] = study.model.factory(ablated)
             elif component is not None:
-                raise ValueError(
-                    f"Trial ablates component {component!r} but the study has no "
-                    "model factory; call study.model.set_factory(fn)."
+                # factory-free path (reference parity: any model, zero
+                # plumbing — loco.py:82-136): derive the variant from the
+                # config model via config.without()/ablated-field rebuild, or
+                # generic param-subtree masking
+                from maggy_tpu.ablation.masking import auto_ablate
+
+                base = available.get("model")
+                if base is None:
+                    raise ValueError(
+                        f"Trial ablates component {component!r} but the study "
+                        "has no model factory and the config has no model; "
+                        "pass AblationConfig(model=...) or call "
+                        "study.model.set_factory(fn)."
+                    )
+                available["model"] = auto_ablate(
+                    base, frozenset(component.split("|"))
                 )
             return available
 

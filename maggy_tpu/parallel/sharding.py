@@ -161,7 +161,10 @@ def constrain_activation(x, logical_axes, rules=DEFAULT_RULES):
         manual = getattr(jax.sharding.AxisType, "Manual", None)
         if not am.empty and manual is not None and manual in set(am.axis_types):
             return x
-    except Exception:
+    except AttributeError:
+        # only for a removed introspection API on older jax; anything else
+        # must stay loud — silently skipping this guard would let an
+        # Auto-mesh constraint poison a Manual region's vjp
         pass
     axes = list(logical_to_mesh_axes(logical_axes, rules))
     for i, axis in enumerate(axes):

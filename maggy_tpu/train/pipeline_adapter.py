@@ -88,6 +88,11 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={n_stages} stages"
         )
+    if getattr(cfg, "ablated", None):
+        raise ValueError(
+            "pp>1 with cfg.ablated is not supported: the stage chunks would "
+            "silently ignore the LOCO gates. Ablate without pipeline stages."
+        )
     if cfg.tie_embeddings:
         raise ValueError(
             "tie_embeddings=True is not supported with pp>1: the input "
