@@ -1,11 +1,14 @@
-"""Train a decoder from on-disk token shards it never fully loads.
+"""Train a decoder straight from a Parquet directory it never fully loads.
 
-The streaming input pipeline (reference petastorm parity, §2.9): tokens are
-written as memory-mapped .npy shards, round-robin split across processes
-(petastorm RANK/WORLD_SIZE semantics), assembled into batches by the C++
-gather on a background thread, and fed through ``shard_batch(local=True)``.
-Also prints the loader's standalone batch rate vs the training step time —
-input is overlapped, so it only needs to be >= the step rate (BENCH note).
+The streaming input pipeline (reference petastorm parity, §2.9): token
+sequences live in Parquet files as fixed-size-list columns, **row groups**
+are the shard unit split round-robin across processes (exactly petastorm's
+RANK/WORLD_SIZE semantics, reference dataloader.py:100-144), batches are
+assembled by the C++ row-gather on a background thread with a two-level
+shuffle, and fed through ``shard_batch(local=True)``. The pre-split ``.npy``
+layout (``ShardedDataset``/``write_sharded``) remains for corpora already
+converted. Also prints the loader's standalone batch rate vs the training
+step time — input is overlapped, so it only needs to be >= the step rate.
 
     python examples/llama_streaming.py
 """
@@ -17,12 +20,16 @@ import time
 
 sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
 
+from maggy_tpu.util import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
 import jax
 import numpy as np
 import optax
 
 from maggy_tpu.models import Decoder, DecoderConfig
-from maggy_tpu.train import ShardedDataset, TrainContext, write_sharded
+from maggy_tpu.train import ParquetShardedDataset, TrainContext, write_parquet
 
 CFG = DecoderConfig.tiny(max_seq_len=256)
 BATCH, SEQ, STEPS = 8, 128, 30
@@ -34,9 +41,12 @@ def main():
     # a mixture of repeated-token rows: learnable next-token structure
     base = rng.integers(0, CFG.vocab_size, (2048, 1), dtype=np.int32)
     tokens = np.tile(base, (1, SEQ))
-    write_sharded(os.path.join(work, "lm"), {"tokens": tokens}, num_shards=32)
+    write_parquet(
+        os.path.join(work, "lm"), {"tokens": tokens},
+        rows_per_group=64, num_files=4,  # 32 row-group shards
+    )
 
-    ds = ShardedDataset(os.path.join(work, "lm"))
+    ds = ParquetShardedDataset(os.path.join(work, "lm"))
     ctx = TrainContext.create("dp" if len(jax.devices()) == 1 else "fsdp")
     trainer = ctx.trainer(Decoder(CFG), optax.adamw(1e-2))
     loader = ds.loader(batch_size=BATCH, ctx=ctx)

@@ -1,11 +1,18 @@
 from maggy_tpu.train.trainer import Trainer, TrainContext, lm_loss_fn, classification_loss_fn
-from maggy_tpu.train.sharded_dataset import ShardedDataset, write_sharded
+from maggy_tpu.train.sharded_dataset import (
+    ParquetShardedDataset,
+    ShardedDataset,
+    write_parquet,
+    write_sharded,
+)
 
 __all__ = [
     "Trainer",
     "TrainContext",
     "lm_loss_fn",
     "classification_loss_fn",
+    "ParquetShardedDataset",
     "ShardedDataset",
+    "write_parquet",
     "write_sharded",
 ]

@@ -40,19 +40,25 @@ from maggy_tpu.train import native_loader
 _SHARD_RE = re.compile(r"shard-(\d{5})\.npy$")
 
 
-def write_sharded(
-    data_dir: str, arrays: Dict[str, np.ndarray], num_shards: int
-) -> None:
-    """Split ``arrays`` row-wise into ``num_shards`` .npy files per field."""
+def _validate_and_split(arrays: Dict[str, np.ndarray], num_chunks: int) -> np.ndarray:
+    """Shared writer validation: non-empty dict, equal leading dims, a chunk
+    count in [1, rows]. Returns the row bounds for ``num_chunks`` chunks."""
     if not arrays:
         raise ValueError("arrays must be a non-empty dict")
     n = {v.shape[0] for v in arrays.values()}
     if len(n) != 1:
         raise ValueError(f"All arrays need equal leading dims, got {n}")
     n = n.pop()
-    if num_shards < 1 or num_shards > n:
-        raise ValueError(f"num_shards must be in [1, {n}]")
-    bounds = np.linspace(0, n, num_shards + 1, dtype=np.int64)
+    if num_chunks < 1 or num_chunks > n:
+        raise ValueError(f"chunk count must be in [1, {n}], got {num_chunks}")
+    return np.linspace(0, n, num_chunks + 1, dtype=np.int64)
+
+
+def write_sharded(
+    data_dir: str, arrays: Dict[str, np.ndarray], num_shards: int
+) -> None:
+    """Split ``arrays`` row-wise into ``num_shards`` .npy files per field."""
+    bounds = _validate_and_split(arrays, num_shards)
     for field, arr in arrays.items():
         field_dir = os.path.join(data_dir, field)
         os.makedirs(field_dir, exist_ok=True)
@@ -63,7 +69,62 @@ def write_sharded(
             )
 
 
-class ShardedDataset:
+class _ShardLoaderMixin:
+    """Shared process-split + loader construction for shard-unit datasets
+    (``.npy`` field shards, Parquet row groups). Subclasses provide
+    ``fields``, ``num_shards`` and ``open_shard(field, shard)``."""
+
+    def read_shard(self, shard: int) -> Dict[str, np.ndarray]:
+        """All fields of one shard. Default: per-field ``open_shard`` calls;
+        columnar subclasses override to read every column in one pass. Must
+        be thread-safe — each loader reads from its own producer thread."""
+        return {f: self.open_shard(f, shard) for f in self.fields}
+
+    def my_shards(self, process_index: int = 0, num_processes: int = 1) -> List[int]:
+        """Round-robin shard assignment (petastorm RANK/WORLD_SIZE split,
+        reference dataloader.py:116-131): disjoint, near-balanced."""
+        if not 0 <= process_index < num_processes:
+            raise ValueError(f"process_index {process_index} not in [0, {num_processes})")
+        if num_processes > self.num_shards:
+            raise ValueError(
+                f"{num_processes} processes but only {self.num_shards} shards; "
+                "write more shards than processes"
+            )
+        return list(range(process_index, self.num_shards, num_processes))
+
+    def loader(
+        self,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        loop: bool = True,
+        prefetch: int = 2,
+        process_index: int = 0,
+        num_processes: int = 1,
+        ctx=None,
+    ) -> "ShardedStreamLoader":
+        """Build the streaming loader for this process's shard subset.
+
+        Pass ``ctx`` (the injected TrainContext) to derive process topology;
+        the batches are *process-local* — feed them through
+        ``trainer.shard_batch(batch, local=True)``.
+        """
+        if ctx is not None:
+            process_index = ctx.process_index
+            num_processes = ctx.num_processes
+        return ShardedStreamLoader(
+            self,
+            self.my_shards(process_index, num_processes),
+            batch_size,
+            shuffle=shuffle,
+            seed=seed + process_index,  # decorrelate shard/row order per process
+            loop=loop,
+            prefetch=prefetch,
+        )
+
+
+class ShardedDataset(_ShardLoaderMixin):
     """Handle on a sharded dataset directory (local path or Env-seam URL)."""
 
     def __init__(self, data_dir: str):
@@ -119,49 +180,161 @@ class ShardedDataset:
         with self._env().open_file(path, "rb") as f:
             return np.load(io.BytesIO(f.read()))
 
-    # ---------------------------------------------------------------- sharding
+class ParquetShardedDataset(_ShardLoaderMixin):
+    """Columnar (Parquet/Arrow) ingestion — the reference's actual input
+    format: petastorm reads parquet row groups sharded by RANK/WORLD_SIZE
+    (reference dataloader.py:100-144). Here the **row group** is the shard
+    unit: files under ``data_dir`` (or a single ``.parquet`` path) are
+    enumerated sorted, their row groups form one global shard list split
+    round-robin across processes, and batches flow through the same
+    two-level shuffle + C++ row-gather as :class:`ShardedDataset`.
 
-    def my_shards(self, process_index: int = 0, num_processes: int = 1) -> List[int]:
-        """Round-robin shard assignment (petastorm RANK/WORLD_SIZE split,
-        reference dataloader.py:116-131): disjoint, near-balanced."""
-        if not 0 <= process_index < num_processes:
-            raise ValueError(f"process_index {process_index} not in [0, {num_processes})")
-        if num_processes > self.num_shards:
-            raise ValueError(
-                f"{num_processes} processes but only {self.num_shards} shards; "
-                "write more shards than processes"
+    Gated on pyarrow (optional dependency): importing this module never
+    touches it; constructing without pyarrow raises with guidance.
+
+    Columns may be scalars (one value per row) or fixed-length lists (token
+    sequences); each maps to a ``[rows, ...]`` numpy field array.
+    """
+
+    def __init__(self, path: str, columns: Optional[List[str]] = None):
+        try:
+            import pyarrow.parquet as pq
+        except ImportError as e:  # pragma: no cover - env without pyarrow
+            raise ImportError(
+                "ParquetShardedDataset needs pyarrow; install it or convert "
+                "the data with write_sharded() to the .npy layout."
+            ) from e
+        self.path = path
+        if os.path.isdir(path):
+            self.files = sorted(
+                os.path.join(path, f)
+                for f in os.listdir(path)
+                if f.endswith((".parquet", ".pq"))
             )
-        return list(range(process_index, self.num_shards, num_processes))
+        else:
+            self.files = [path]
+        if not self.files:
+            raise ValueError(f"No .parquet files under {path!r}")
+        # global shard list: (file, row_group) in deterministic order; every
+        # file's schema is checked for the selected columns AND their types
+        # (a missing column or a different fixed-list width must fail here,
+        # not as a mid-training producer error)
+        self._units: List[tuple] = []
+        first_schema = None
+        col_types = None
+        for f in self.files:
+            pf = pq.ParquetFile(f)
+            schema = pf.schema_arrow
+            if first_schema is None:
+                first_schema = schema
+                self.fields = list(columns) if columns else list(schema.names)
+                missing = [c for c in self.fields if c not in schema.names]
+                if missing:
+                    raise ValueError(
+                        f"Columns {missing} not in parquet schema {schema.names}"
+                    )
+                col_types = {c: schema.field(c).type for c in self.fields}
+            else:
+                for c in self.fields:
+                    if c not in schema.names:
+                        raise ValueError(
+                            f"File {f!r} lacks column {c!r} present in "
+                            f"{self.files[0]!r}"
+                        )
+                    if schema.field(c).type != col_types[c]:
+                        raise ValueError(
+                            f"Column {c!r} type mismatch: {schema.field(c).type} "
+                            f"in {f!r} vs {col_types[c]} in {self.files[0]!r}"
+                        )
+            self._units.extend((f, g) for g in range(pf.metadata.num_row_groups))
+        self.num_shards = len(self._units)
+        if self.num_shards == 0:
+            raise ValueError(f"No row groups in {path!r}")
+        # ParquetFile handles are stateful and not thread-safe; each loader
+        # reads from its own producer thread, so cache handles per thread
+        self._tls = threading.local()
 
-    def loader(
-        self,
-        batch_size: int,
-        *,
-        shuffle: bool = True,
-        seed: int = 0,
-        loop: bool = True,
-        prefetch: int = 2,
-        process_index: int = 0,
-        num_processes: int = 1,
-        ctx=None,
-    ) -> "ShardedStreamLoader":
-        """Build the streaming loader for this process's shard subset.
+    def _file(self, path: str):
+        import pyarrow.parquet as pq
 
-        Pass ``ctx`` (the injected TrainContext) to derive process topology;
-        the batches are *process-local* — feed them through
-        ``trainer.shard_batch(batch, local=True)``.
-        """
-        if ctx is not None:
-            process_index = ctx.process_index
-            num_processes = ctx.num_processes
-        return ShardedStreamLoader(
-            self,
-            self.my_shards(process_index, num_processes),
-            batch_size,
-            shuffle=shuffle,
-            seed=seed + process_index,  # decorrelate shard/row order per process
-            loop=loop,
-            prefetch=prefetch,
+        handles = getattr(self._tls, "handles", None)
+        if handles is None:
+            handles = self._tls.handles = {}
+        pf = handles.get(path)
+        if pf is None:
+            if len(handles) >= 8:  # bounded per-thread handle cache
+                handles.pop(next(iter(handles)))
+            pf = handles[path] = pq.ParquetFile(path)
+        return pf
+
+    def read_shard(self, shard: int) -> Dict[str, np.ndarray]:
+        """One row group, all selected columns in a single read."""
+        path, group = self._units[shard]
+        table = self._file(path).read_row_group(group, columns=self.fields)
+        return {f: _arrow_column_to_numpy(table.column(f)) for f in self.fields}
+
+    def open_shard(self, field: str, shard: int) -> np.ndarray:
+        """One row group's column as a ``[rows, ...]`` array."""
+        path, group = self._units[shard]
+        table = self._file(path).read_row_group(group, columns=[field])
+        return _arrow_column_to_numpy(table.column(field))
+
+
+def _arrow_column_to_numpy(col) -> np.ndarray:
+    """Arrow column -> contiguous numpy rows: scalars as 1-D, (fixed-size)
+    lists as 2-D — ragged lists are rejected (pad/pack upstream)."""
+    import pyarrow as pa
+
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    t = arr.type
+    if pa.types.is_fixed_size_list(t):
+        values = arr.flatten().to_numpy(zero_copy_only=False)
+        return np.ascontiguousarray(values.reshape(len(arr), t.list_size))
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        lengths = arr.value_lengths().to_numpy(zero_copy_only=False)
+        uniq = np.unique(lengths)
+        if len(uniq) != 1:
+            raise ValueError(
+                f"Ragged list column (lengths {uniq[:5]}...); sequences must "
+                "be padded/packed to a fixed length upstream"
+            )
+        values = arr.flatten().to_numpy(zero_copy_only=False)
+        return np.ascontiguousarray(values.reshape(len(arr), int(uniq[0])))
+    return np.ascontiguousarray(arr.to_numpy(zero_copy_only=False))
+
+
+def write_parquet(
+    path: str,
+    arrays: Dict[str, np.ndarray],
+    *,
+    rows_per_group: int,
+    num_files: int = 1,
+) -> None:
+    """Test/example helper: write ``arrays`` as Parquet with explicit row
+    groups (2-D arrays become fixed-size-list columns)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    # empty part files would each still carry one empty row group, which
+    # becomes a shard whose loader busy-spins — reject up front
+    bounds = _validate_and_split(arrays, num_files)
+
+    def column(arr):
+        if arr.ndim == 1:
+            return pa.array(arr)
+        if arr.ndim == 2:
+            flat = pa.array(np.ascontiguousarray(arr).reshape(-1))
+            return pa.FixedSizeListArray.from_arrays(flat, arr.shape[1])
+        raise ValueError("write_parquet supports 1-D and 2-D arrays")
+
+    os.makedirs(path, exist_ok=True)
+    for i in range(num_files):
+        chunk = {k: v[bounds[i] : bounds[i + 1]] for k, v in arrays.items()}
+        table = pa.table({k: column(v) for k, v in chunk.items()})
+        pq.write_table(
+            table,
+            os.path.join(path, f"part-{i:05d}.parquet"),
+            row_group_size=rows_per_group,
         )
 
 
@@ -274,7 +447,7 @@ def _stream_batches(loader_ref, q, stop) -> None:
             if loader is None or stop.is_set():
                 return
             lib = loader._lib
-            arrays = {f: ds.open_shard(f, s) for f in ds.fields}
+            arrays = ds.read_shard(s)
             n = next(iter(arrays.values())).shape[0]
             perm = loader._perm(n, salt=epoch * 100_003 + s + 1)
             del loader

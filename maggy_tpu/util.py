@@ -21,14 +21,27 @@ from maggy_tpu import constants, exceptions
 
 
 def force_cpu() -> None:
-    """Pin JAX to the CPU backend (env var + config, belt and braces against
-    plugins that re-assert their platform). Must run before any backend use."""
+    """Pin JAX to the CPU backend (env var + config + dropping the
+    accelerator plugin's backend factory — belt and braces against plugins
+    that re-assert their platform). Must run before any backend use.
+
+    Dropping the factory matters on this image: the tunnel plugin registers
+    at interpreter start and its backend *init* can hang forever when the
+    transport is wedged — observed even in env/config-pinned CPU processes.
+    With the factory gone, backends() cannot touch it at all."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:  # very old jax without the option — env var still set
+        pass
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if not _xb.backends_are_initialized():
+            _xb._backend_factories.pop("axon", None)
+    except Exception:  # private API drift: env+config pins still apply
         pass
 
 

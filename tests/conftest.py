@@ -14,11 +14,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# The TPU plugin on this image re-asserts its platform over the env var, so pin
-# the platform through jax.config too (must happen before any backend init).
-import jax
+# The TPU plugin on this image re-asserts its platform over the env var (and
+# its backend init can hang on a wedged tunnel even from CPU-pinned
+# processes), so pin through jax.config AND drop its backend factory (must
+# happen before any backend init).
+from maggy_tpu.util import force_cpu
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu()
 
 import pytest
 
