@@ -272,15 +272,16 @@ def auto_attention(q, k, v, *, causal: bool = True, segment_ids=None):
     sk = k.shape[1]
     if (
         jax.default_backend() == "tpu"
-        and segment_ids is None
         and d % 128 == 0
         and sq % 128 == 0
         and sk % 128 == 0
     ):
         mesh = ambient_mesh()
         if mesh is None or mesh.size == 1:
-            return flash_attention(q, k, v, causal=causal)
-        out = sharded_flash_attention(q, k, v, mesh=mesh, causal=causal)
+            return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        out = sharded_flash_attention(
+            q, k, v, mesh=mesh, causal=causal, segment_ids=segment_ids
+        )
         if out is not None:
             return out
     return default_attention(q, k, v, causal=causal, segment_ids=segment_ids)
@@ -311,7 +312,7 @@ class Attention(nn.Module):
     cfg: DecoderConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
         hd = cfg.head_dim
         q = _dense((cfg.n_heads, hd), ("embed", "heads", None), cfg, "wq")(x)
@@ -320,10 +321,15 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         if cfg.decode:
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "the KV-cache decode path has no segment masking; "
+                    "prefill packed batches with decode=False"
+                )
             out = self._cached_attention(q, k, v, positions)
         else:
             attn = cfg.attention_fn or auto_attention
-            out = attn(q, k, v, causal=True)
+            out = attn(q, k, v, causal=True, segment_ids=segment_ids)
             # under remat="dots_attn" this tag saves the kernel output so the
             # backward reads it instead of re-running the flash forward
             # (plain "dots" ignores the tag and recomputes)
@@ -416,12 +422,13 @@ class DecoderLayer(nn.Module):
     cfg: DecoderConfig
 
     @nn.compact
-    def __call__(self, x, positions, gates=None):
+    def __call__(self, x, positions, gates=None, segment_ids=None):
         """``gates`` — optional [2] float (attn, mlp) LOCO ablation gates: a
         zero gate removes that sublayer's contribution (residual becomes
-        identity) and cuts its gradients, with an unchanged param tree."""
+        identity) and cuts its gradients, with an unchanged param tree.
+        ``segment_ids`` — optional [B, S] packed-sequence ids."""
         a = Attention(self.cfg, name="attn")(
-            RMSNorm(self.cfg, name="attn_norm")(x), positions
+            RMSNorm(self.cfg, name="attn_norm")(x), positions, segment_ids
         )
         x = x + (a if gates is None else a * gates[0].astype(a.dtype))
         m = MLPBlock(self.cfg, name="mlp")(RMSNorm(self.cfg, name="mlp_norm")(x))
@@ -433,8 +440,10 @@ class _ScannedLayer(nn.Module):
     cfg: DecoderConfig
 
     @nn.compact
-    def __call__(self, x, positions):
-        return DecoderLayer(self.cfg, name="layer")(x, positions), None
+    def __call__(self, x, positions, segment_ids=None):
+        return DecoderLayer(self.cfg, name="layer")(
+            x, positions, None, segment_ids
+        ), None
 
 
 class _ScannedGatedLayer(nn.Module):
@@ -444,8 +453,10 @@ class _ScannedGatedLayer(nn.Module):
     cfg: DecoderConfig
 
     @nn.compact
-    def __call__(self, x, positions, gates):
-        return DecoderLayer(self.cfg, name="layer")(x, positions, gates), None
+    def __call__(self, x, positions, gates, segment_ids=None):
+        return DecoderLayer(self.cfg, name="layer")(
+            x, positions, gates, segment_ids
+        ), None
 
 
 class Decoder(nn.Module):
@@ -454,7 +465,10 @@ class Decoder(nn.Module):
     cfg: DecoderConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, segment_ids=None):
+        """``positions`` default to per-row arange; packed batches pass both
+        ``positions`` (restarting per segment) and ``segment_ids`` [B, S]
+        (attention masks across segment boundaries, SURVEY §5.7)."""
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -483,22 +497,29 @@ class Decoder(nn.Module):
                 layer_cls,
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
-                # positions are the same for every layer; LOCO gates are per-layer
-                in_axes=nn.broadcast if gates is None else (nn.broadcast, 0),
+                # positions/segment_ids are the same for every layer; LOCO
+                # gates are per-layer
+                in_axes=(
+                    (nn.broadcast, nn.broadcast)
+                    if gates is None
+                    else (nn.broadcast, 0, nn.broadcast)
+                ),
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, name="layers")
             if gates is None:
-                x, _ = scanned(x, positions)
+                x, _ = scanned(x, positions, segment_ids)
             else:
-                x, _ = scanned(x, positions, jnp.asarray(gates))
+                x, _ = scanned(x, positions, jnp.asarray(gates), segment_ids)
         else:
             for i in range(cfg.n_layers):
                 if gates is None:
-                    x, _ = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+                    x, _ = layer_cls(cfg, name=f"layers_{i}")(
+                        x, positions, segment_ids
+                    )
                 else:
                     x, _ = layer_cls(cfg, name=f"layers_{i}")(
-                        x, positions, jnp.asarray(gates[i])
+                        x, positions, jnp.asarray(gates[i]), segment_ids
                     )
 
         x = RMSNorm(cfg, name="final_norm")(x)

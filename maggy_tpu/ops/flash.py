@@ -45,9 +45,14 @@ def _tile_mask(q_start, k_start, block_q, block_k):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, block_q, block_k,
+    *refs,
+    scale, causal, block_q, block_k, segmented,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -71,13 +76,16 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
-            mask = _tile_mask(q_start, k_start, block_q, block_k)
+        mask = _tile_mask(q_start, k_start, block_q, block_k) if causal else None
+        if segmented:
+            smask = qseg_ref[0][:, None] == kseg_ref[0][None, :]
+            mask = smask if mask is None else (mask & smask)
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_ref[:, :1]
         m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_cur)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_cur)
         l_new = l_ref[:, :1] * corr + p.sum(axis=1, keepdims=True)
@@ -102,12 +110,26 @@ def _fwd_kernel(
         )
 
 
-def _fwd_call(q, k, v, *, causal, block_q, block_k, group, interpret):
+def _fwd_call(q, k, v, segs, *, causal, block_q, block_k, group, heads, interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
     grid = (bh, sq // block_q, sk // block_k)
+    segmented = segs is not None
     # GQA lives in the index map: q-head row i reads KV row i // group, so the
-    # repeated [B,S,H,D] K/V never materialize in HBM (review finding r2)
+    # repeated [B,S,H,D] K/V never materialize in HBM (review finding r2);
+    # segment ids are per (batch, seq) — row i // heads — shared by all heads
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i // group, ki, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i // group, ki, 0), memory_space=pltpu.VMEM),
+    ]
+    operands = [q, k, v]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda i, qi, ki: (i // heads, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda i, qi, ki: (i // heads, ki), memory_space=pltpu.VMEM),
+        ]
+        operands += [segs, segs]
     return pl.pallas_call(
         functools.partial(
             _fwd_kernel,
@@ -115,13 +137,10 @@ def _fwd_call(q, k, v, *, causal, block_q, block_k, group, interpret):
             causal=causal,
             block_q=block_q,
             block_k=block_k,
+            segmented=segmented,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i // group, ki, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i // group, ki, 0), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(
@@ -138,22 +157,29 @@ def _fwd_call(q, k, v, *, causal, block_q, block_k, group, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
 
 # -------------------------------------------------------------------- backward
 
 
-def _recompute_p_ds(q, k, v, o, do, lse, *, scale, causal, q_start, k_start):
+def _recompute_p_ds(
+    q, k, v, o, do, lse, *, scale, causal, q_start, k_start, qseg=None, kseg=None
+):
     """Shared tile math: probabilities from the saved LSE, then
-    dS = P * (dP - delta) * scale with delta recomputed from the O/dO tiles."""
+    dS = P * (dP - delta) * scale with delta recomputed from the O/dO tiles.
+    The full forward mask (causal AND segments) must be re-applied — exp(s -
+    lse) is not zero for positions the forward masked out."""
     block_q, block_k = q.shape[0], k.shape[0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     p = jnp.exp(s - lse)  # lse [block_q, 1]
-    if causal:
-        mask = _tile_mask(q_start, k_start, block_q, block_k)
+    mask = _tile_mask(q_start, k_start, block_q, block_k) if causal else None
+    if qseg is not None:
+        smask = qseg[:, None] == kseg[None, :]
+        mask = smask if mask is None else (mask & smask)
+    if mask is not None:
         p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -166,9 +192,14 @@ def _recompute_p_ds(q, k, v, o, do, lse, *, scale, causal, q_start, k_start):
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc_ref,
-    *, scale, causal, block_q, block_k,
+    *refs,
+    scale, causal, block_q, block_k, segmented,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, qseg_ref, kseg_ref,
+         dq_ref, acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, acc_ref = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -187,6 +218,8 @@ def _dq_kernel(
         _, ds = _recompute_p_ds(
             q_ref[0], k, v_ref[0], o_ref[0], do_ref[0], lse_ref[0, 0],
             scale=scale, causal=causal, q_start=q_start, k_start=k_start,
+            qseg=qseg_ref[0] if segmented else None,
+            kseg=kseg_ref[0] if segmented else None,
         )
         acc_ref[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -199,10 +232,15 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
-    dk_acc_ref, dv_acc_ref,
-    *, scale, causal, block_q, block_k,
+    *refs,
+    scale, causal, block_q, block_k, segmented,
 ):
+    if segmented:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, qseg_ref, kseg_ref,
+         dk_ref, dv_ref, dk_acc_ref, dv_acc_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+         dk_ref, dv_ref, dk_acc_ref, dv_acc_ref) = refs
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -224,6 +262,8 @@ def _dkv_kernel(
         p, ds = _recompute_p_ds(
             q, k_ref[0], v_ref[0], o_ref[0], do, lse_ref[0, 0],
             scale=scale, causal=causal, q_start=q_start, k_start=k_start,
+            qseg=qseg_ref[0] if segmented else None,
+            kseg=kseg_ref[0] if segmented else None,
         )
         # dV += P^T dO ; dK += dS^T Q — contract the q dim of both operands
         dv_acc_ref[:] += jax.lax.dot_general(
@@ -241,26 +281,39 @@ def _dkv_kernel(
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, do, lse, *, causal, block_q, block_k, group, interpret):
+def _bwd_call(
+    q, k, v, o, do, lse, segs,
+    *, causal, block_q, block_k, group, heads, interpret,
+):
     bh, sq, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / d**0.5
+    segmented = segs is not None
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0), memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i // group, ki, 0), memory_space=pltpu.VMEM)
     lse_spec = pl.BlockSpec(
         (1, 1, block_q, 1), lambda i, qi, ki: (i, qi, 0, 0), memory_space=pltpu.VMEM
     )
+    in_specs = [q_spec, k_spec, k_spec, q_spec, q_spec, lse_spec]
+    operands = [q, k, v, o, do, lse]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda i, qi, ki: (i // heads, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda i, qi, ki: (i // heads, ki), memory_space=pltpu.VMEM),
+        ]
+        operands += [segs, segs]
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+            _dq_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, segmented=segmented,
         ),
         grid=(bh, sq // block_q, sk // block_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, lse_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(*operands)
 
     # dkv grid: KV blocks outer, Q blocks inner (accumulate across Q). Outputs
     # are per *q-head* ([BH, S, D]); a KV block cannot accumulate across grid-i
@@ -271,12 +324,21 @@ def _bwd_call(q, k, v, o, do, lse, *, causal, block_q, block_k, group, interpret
     lse_spec2 = pl.BlockSpec(
         (1, 1, block_q, 1), lambda i, ki, qi: (i, qi, 0, 0), memory_space=pltpu.VMEM
     )
+    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, q_spec2, lse_spec2]
+    operands2 = [q, k, v, o, do, lse]
+    if segmented:
+        in_specs2 += [
+            pl.BlockSpec((1, block_q), lambda i, ki, qi: (i // heads, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda i, ki, qi: (i // heads, ki), memory_space=pltpu.VMEM),
+        ]
+        operands2 += [segs, segs]
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, segmented=segmented,
         ),
         grid=(bh, sk // block_k, sq // block_q),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, q_spec2, lse_spec2],
+        in_specs=in_specs2,
         out_specs=[o_spec2, o_spec2],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
@@ -287,38 +349,48 @@ def _bwd_call(q, k, v, o, do, lse, *, causal, block_q, block_k, group, interpret
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(*operands2)
     return dq, dk, dv
 
 
 @functools.lru_cache(maxsize=None)
-def _flash_core(causal: bool, block_q: int, block_k: int, group: int, interpret: bool):
+def _flash_core(
+    causal: bool, block_q: int, block_k: int, group: int, heads: int,
+    interpret: bool, segmented: bool,
+):
     """Differentiable flash attention on q [B*H, S, D], k/v [B*Kh, S, D]
     (GQA group = H // Kh handled by kernel index maps — the repeated K/V
-    never exist, in HBM or as residuals)."""
+    never exist, in HBM or as residuals). With ``segmented``, a fourth
+    [B, S] int32 operand masks attention across packed-sequence
+    boundaries (zero cotangent)."""
 
     kw = dict(causal=causal, block_q=block_q, block_k=block_k, group=group,
-              interpret=interpret)
+              heads=heads, interpret=interpret)
 
     @jax.custom_vjp
-    def core(q, k, v):
-        return _fwd_call(q, k, v, **kw)[0]
+    def core(q, k, v, segs):
+        return _fwd_call(q, k, v, segs if segmented else None, **kw)[0]
 
-    def core_fwd(q, k, v):
-        o, lse = _fwd_call(q, k, v, **kw)
-        return o, (q, k, v, o, lse)
+    def core_fwd(q, k, v, segs):
+        o, lse = _fwd_call(q, k, v, segs if segmented else None, **kw)
+        return o, (q, k, v, segs, o, lse)
 
     def core_bwd(res, g):
-        q, k, v, o, lse = res
-        dq, dk_h, dv_h = _bwd_call(q, k, v, o, g.astype(o.dtype), lse, **kw)
-        if group == 1:
-            return dq, dk_h, dv_h
-        # dkv kernel emits per-q-head grads; sum each GQA group in fp32
-        bh, sk, d = dk_h.shape
-        def gsum(x, dtype):
-            x = x.reshape(bh // group, group, sk, d).astype(jnp.float32)
-            return x.sum(axis=1).astype(dtype)
-        return dq, gsum(dk_h, k.dtype), gsum(dv_h, v.dtype)
+        q, k, v, segs, o, lse = res
+        dq, dk_h, dv_h = _bwd_call(
+            q, k, v, o, g.astype(o.dtype), lse,
+            segs if segmented else None, **kw,
+        )
+        if group > 1:
+            # dkv kernel emits per-q-head grads; sum each GQA group in fp32
+            bh, sk, d = dk_h.shape
+
+            def gsum(x, dtype):
+                x = x.reshape(bh // group, group, sk, d).astype(jnp.float32)
+                return x.sum(axis=1).astype(dtype)
+
+            dk_h, dv_h = gsum(dk_h, k.dtype), gsum(dv_h, v.dtype)
+        return dq, dk_h, dv_h, None  # int segment ids: no cotangent
 
     core.defvjp(core_fwd, core_bwd)
     return core
@@ -356,27 +428,42 @@ def flash_attention(
 ) -> jax.Array:
     """q [B,S,H,D], k/v [B,S,Kh,D] → [B,S,H,D]. Differentiable (custom VJP).
     ``block_q``/``block_k`` default to the measured-fastest tiling for the
-    sequence length (``_auto_blocks``)."""
-    if segment_ids is not None:
-        return blockwise_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    sequence length (``_auto_blocks``). ``segment_ids`` [B, S] masks
+    attention across packed-sequence boundaries in-kernel."""
     b, sq, h, d = q.shape
     kh = k.shape[2]
     sk = k.shape[1]
     auto_q, auto_k = _auto_blocks(sq, sk)
     block_q = min(block_q, sq) if block_q else auto_q
     block_k = min(block_k, sk) if block_k else auto_k
-    # fall back unless blocks tile evenly AND stay sublane-aligned (multiple
-    # of 8 rows) — Mosaic cannot lower arbitrary-row tiles
-    if sq % block_q or sk % block_k or d % _LANES or block_q % 8 or block_k % 8:
-        return blockwise_attention(q, k, v, causal=causal)  # repeats GQA itself
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # fall back unless blocks tile evenly AND stay sublane-aligned (multiple
+    # of 8 rows) — Mosaic cannot lower arbitrary-row tiles. Segment-id tiles
+    # [1, block] put the block in the lane dim, so compiled (non-interpret)
+    # segmented runs additionally need lane-aligned blocks.
+    unaligned = sq % block_q or sk % block_k or d % _LANES or block_q % 8 or block_k % 8
+    seg_unaligned = segment_ids is not None and not interpret and (
+        block_q % _LANES or block_k % _LANES
+    )
+    if unaligned or seg_unaligned:
+        return blockwise_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids
+        )  # repeats GQA itself
 
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * kh, sk, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * kh, sk, d)
 
-    out = _flash_core(causal, block_q, block_k, h // kh, interpret)(qr, kr, vr)
+    segmented = segment_ids is not None
+    segs = (
+        segment_ids.astype(jnp.int32)
+        if segmented
+        else jnp.zeros((b, sq), jnp.int32)  # placeholder, never read
+    )
+    out = _flash_core(causal, block_q, block_k, h // kh, h, interpret, segmented)(
+        qr, kr, vr, segs
+    )
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
@@ -388,6 +475,7 @@ def sharded_flash_attention(
     mesh,
     causal: bool = True,
     interpret: Optional[bool] = None,
+    segment_ids: Optional[jax.Array] = None,
 ):
     """Run the Pallas kernel per-shard under ``shard_map`` over ``mesh``.
 
@@ -423,6 +511,14 @@ def sharded_flash_attention(
         return None
     spec = P((AXIS_DATA, AXIS_FSDP), None, AXIS_TENSOR, None)
     fn = functools.partial(flash_attention, causal=causal, interpret=interpret)
+    if segment_ids is None:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    seg_spec = P((AXIS_DATA, AXIS_FSDP), None)
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-    )(q, k, v)
+        lambda q, k, v, s: fn(q, k, v, segment_ids=s),
+        mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v, segment_ids)

@@ -29,28 +29,36 @@ def _local_ring_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    seg: jax.Array,
     *,
     axis_name: str,
     num_shards: int,
     causal: bool,
+    use_segments: bool,
 ):
     """Runs on each device under shard_map: q [B,C,H,D], k/v [B,C,Kh,D] local
     seq shards. KV rotates at its native (grouped) head count — broadcasting to
     the query head count happens per-step on the compute side, so GQA pays
-    h/kh times less ICI traffic."""
+    h/kh times less ICI traffic. With ``use_segments``, the [B,C] segment-id
+    shard rotates alongside KV and scores are masked where query and key
+    segments differ (packed sequences, SURVEY §5.7)."""
     b, c, h, d = q.shape
     scale = 1.0 / (d**0.5)
     my_idx = jax.lax.axis_index(axis_name)
     q_pos = my_idx * c + jnp.arange(c)
 
     def body(step, carry):
-        acc, m, l, k_cur, v_cur = carry
+        acc, m, l, k_cur, v_cur, seg_cur = carry
         src = (my_idx - step) % num_shards  # which KV chunk we hold this step
         k_pos = src * c + jnp.arange(c)
         if causal:
             mask = (q_pos[None, None, :, None] >= k_pos[None, None, None, :])
         else:
             mask = jnp.ones((1, 1, c, c), bool)
+        if use_segments:
+            mask = mask & (
+                seg[:, None, :, None] == seg_cur[:, None, None, :]
+            )
         acc, m, l = ops_attn.online_block_update(
             (acc, m, l),
             q,
@@ -59,14 +67,18 @@ def _local_ring_attention(
             mask,
             scale,
         )
-        # rotate KV to the next device; device i receives chunk from i-1
+        # rotate KV (and its segment ids) to the next device; device i
+        # receives the chunk from i-1
         perm = [(i, (i + 1) % num_shards) for i in range(num_shards)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return acc, m, l, k_nxt, v_nxt
+        seg_nxt = (
+            jax.lax.ppermute(seg_cur, axis_name, perm) if use_segments else seg_cur
+        )
+        return acc, m, l, k_nxt, v_nxt, seg_nxt
 
-    carry = (*ops_attn.init_carry(b, h, c, d), k, v)
-    acc, m, l, _, _ = jax.lax.fori_loop(0, num_shards, body, carry)
+    carry = (*ops_attn.init_carry(b, h, c, d), k, v, seg)
+    acc, m, l, _, _, _ = jax.lax.fori_loop(0, num_shards, body, carry)
     return ops_attn._finalize(acc, l, q.dtype)
 
 
@@ -101,45 +113,62 @@ def ring_attention(
         allows).
     :param interpret: pallas only — run under the TPU interpret machine
         (defaults to True off-TPU so CPU meshes can test the kernel).
+    :param segment_ids: optional [B, S] int ids for packed sequences (sharded
+        on S like q/k/v); tokens only attend within their own segment. The
+        segment-id shard rotates around the ring with its KV shard. Supported
+        on the XLA ring; the Pallas kernel rejects it for now.
     """
-    if segment_ids is not None:
-        raise NotImplementedError("ring attention does not support segment_ids yet")
     if impl == "auto":
         # resolve from the mesh's devices, not the process default backend —
         # a CPU mesh created on a TPU-capable host must not pick pallas
         on_tpu = mesh.devices.flat[0].platform == "tpu"
         opt_in = os.environ.get("MAGGY_TPU_RING_PALLAS") == "1"
-        impl = "pallas" if (on_tpu and opt_in) else "xla"
+        impl = "pallas" if (on_tpu and opt_in and segment_ids is None) else "xla"
     if impl not in ("xla", "pallas"):
         raise ValueError(f"impl must be 'xla', 'pallas', or 'auto', got {impl!r}")
     num_shards = mesh.shape[axis_name]
     if num_shards == 1:
-        return ops_attn.blockwise_attention(q, k, v, causal=causal)
+        return ops_attn.blockwise_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids
+        )
 
     if impl == "pallas":
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "the Pallas RDMA ring kernel does not support segment_ids; "
+                "use impl='xla' (or 'auto', which routes packed batches there)"
+            )
         return _pallas_ring(
             q, k, v, mesh=mesh, causal=causal, axis_name=axis_name,
             interpret=interpret,
         )
-    return _xla_ring(q, k, v, mesh=mesh, causal=causal, axis_name=axis_name)
+    return _xla_ring(
+        q, k, v, segment_ids, mesh=mesh, causal=causal, axis_name=axis_name
+    )
 
 
-def _xla_ring(q, k, v, *, mesh, causal, axis_name):
+def _xla_ring(q, k, v, segment_ids, *, mesh, causal, axis_name):
     num_shards = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
+    seg_spec = P(None, axis_name)
+    use_segments = segment_ids is not None
+    if not use_segments:
+        # uniform dummy (never read): keeps one shard_map signature
+        segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
     fn = functools.partial(
         _local_ring_attention,
         axis_name=axis_name,
         num_shards=num_shards,
         causal=causal,
+        use_segments=use_segments,
     )
     return jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, seg_spec),
         out_specs=spec,
         check_vma=False,
-    )(q, k, v)
+    )(q, k, v, segment_ids)
 
 
 def _pallas_ring(q, k, v, *, mesh, causal, axis_name, interpret):

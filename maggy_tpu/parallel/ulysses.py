@@ -14,6 +14,7 @@ import functools
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from maggy_tpu.ops.attention import _repeat_kv, blockwise_attention
@@ -21,7 +22,8 @@ from maggy_tpu.parallel.spec import AXIS_SEQ
 
 
 def _local_ulysses(
-    q, k, v, *, axis_name: str, num_shards: int, causal: bool, attn_fn: Callable
+    q, k, v, seg, *, axis_name: str, num_shards: int, causal: bool,
+    attn_fn: Callable, use_segments: bool,
 ):
     # local: [B, C, H, D] with C = S/n; re-shard to [B, S, H/n, D]
     def seq_to_heads(x):
@@ -37,7 +39,14 @@ def _local_ulysses(
     qh = seq_to_heads(q)
     kh = seq_to_heads(k)
     vh = seq_to_heads(v)
-    out = attn_fn(qh, kh, vh, causal=causal)
+    if use_segments:
+        # head-parallel attention sees the FULL sequence, so every device
+        # needs the full [B, S] segment ids — an all_gather of the int
+        # shard (a few KB, nothing next to the qkv all-to-alls)
+        seg_full = jax.lax.all_gather(seg, axis_name, axis=1, tiled=True)
+        out = attn_fn(qh, kh, vh, causal=causal, segment_ids=seg_full)
+    else:
+        out = attn_fn(qh, kh, vh, causal=causal)
     return heads_to_seq(out)
 
 
@@ -53,9 +62,11 @@ def ulysses_attention(
     segment_ids=None,
 ):
     """Global-view Ulysses attention: q [B,S,H,D] sharded on S over
-    ``axis_name``; requires n | H and n | Kh (the all-to-all splits heads)."""
-    if segment_ids is not None:
-        raise NotImplementedError("ulysses attention does not support segment_ids yet")
+    ``axis_name``; requires n | H and n | Kh (the all-to-all splits heads).
+
+    ``segment_ids`` [B, S] (sharded on S) enables packed sequences: the local
+    head-parallel attention receives the all-gathered full-length ids and
+    masks across segment boundaries."""
     num_shards = mesh.shape[axis_name]
     h, kh = q.shape[2], k.shape[2]
     if num_shards > 1 and kh % num_shards != 0:
@@ -64,15 +75,20 @@ def ulysses_attention(
         v = _repeat_kv(v, h)
         kh = h
     inner = attn_fn or (
-        lambda q, k, v, causal=True: blockwise_attention(q, k, v, causal=causal)
+        lambda q, k, v, causal=True, segment_ids=None: blockwise_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids
+        )
     )
     if num_shards == 1:
-        return inner(q, k, v, causal=causal)
+        return inner(q, k, v, causal=causal, segment_ids=segment_ids)
     if h % num_shards != 0:
         raise ValueError(
             f"Ulysses needs the seq-axis size ({num_shards}) to divide the head "
             f"count ({h}); use ring attention instead."
         )
+    use_segments = segment_ids is not None
+    if not use_segments:
+        segment_ids = jnp.zeros(q.shape[:2], jnp.int32)  # uniform dummy
     spec = P(None, axis_name, None, None)
     fn = functools.partial(
         _local_ulysses,
@@ -80,10 +96,13 @@ def ulysses_attention(
         num_shards=num_shards,
         causal=causal,
         attn_fn=inner,
+        use_segments=use_segments,
     )
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-    )(q, k, v)
+        fn, mesh=mesh,
+        in_specs=(spec, spec, spec, P(None, axis_name)),
+        out_specs=spec, check_vma=False,
+    )(q, k, v, segment_ids)
 
 
 def make_ulysses_attention(mesh, axis_name: str = AXIS_SEQ, attn_fn=None):

@@ -40,15 +40,21 @@ class TrainState(train_state.TrainState):
 
 def lm_loss_fn(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
     """Next-token cross entropy over ``batch["tokens"]`` with optional
-    ``batch["loss_mask"]``."""
+    ``batch["loss_mask"]``. With ``batch["segment_ids"]`` (packed sequences)
+    the boundary positions — where the target token belongs to a different
+    segment than its predictor — are masked out automatically."""
     tokens = batch["tokens"]
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("loss_mask")
+    mask = None if mask is None else mask[:, 1:].astype(jnp.float32)
+    seg = batch.get("segment_ids")
+    if seg is not None:
+        same = (seg[:, 1:] == seg[:, :-1]).astype(jnp.float32)
+        mask = same if mask is None else mask * same
     if mask is not None:
-        mask = mask[:, 1:].astype(jnp.float32)
         return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     return -ll.mean()
 
@@ -61,7 +67,14 @@ def classification_loss_fn(logits: jax.Array, batch: Dict[str, jax.Array]) -> ja
 
 def _model_inputs(batch: Dict[str, jax.Array]) -> Tuple:
     if "tokens" in batch:
-        return (batch["tokens"],)
+        args = [batch["tokens"]]
+        # packed sequences: optional positions (restarting per segment) and
+        # segment_ids ride through to the model's extra positional args
+        if "positions" in batch or "segment_ids" in batch:
+            args.append(batch.get("positions"))
+            if "segment_ids" in batch:
+                args.append(batch["segment_ids"])
+        return tuple(args)
     if "inputs" in batch:
         return (batch["inputs"],)
     raise KeyError("Batch must contain 'tokens' (LM) or 'inputs' (generic)")
@@ -226,6 +239,15 @@ class Trainer:
         dpf = shape.get(shd.AXIS_DATA, 1) * shape.get(shd.AXIS_FSDP, 1)
 
         def train_step(state: TrainState, batch):
+            if isinstance(batch, dict) and (
+                "segment_ids" in batch or "positions" in batch
+            ):
+                raise ValueError(
+                    "packed sequences (segment_ids/positions) are not "
+                    "supported under pp>1 yet — the stage adapter would "
+                    "silently use default arange positions; drop the stage "
+                    "axis or unpack the batch"
+                )
             tokens = _model_inputs(batch)[0]
             bsz = tokens.shape[0]
             if bsz % n_micro:

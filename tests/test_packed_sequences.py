@@ -1,0 +1,169 @@
+"""Packed sequences (segment_ids) through every attention path (VERDICT r3
+item 5, SURVEY §5.7): blockwise, the XLA ring and Ulysses on the sp=4 mesh,
+the Pallas flash kernel (interpret machine), and the Decoder/Trainer
+end-to-end. The ground truth everywhere: packed attention over segments ==
+dense attention run on each segment separately."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from maggy_tpu.models.transformer import default_attention
+from maggy_tpu.ops.attention import blockwise_attention
+from maggy_tpu.ops.flash import flash_attention
+from maggy_tpu.parallel.ringattention import ring_attention
+from maggy_tpu.parallel.ulysses import ulysses_attention
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs the 8-device CPU mesh"
+)
+
+
+def _mesh(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+
+def _packed(B=2, S=128, H=4, KH=2, D=16, n_segs=3, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 4)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, KH, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, KH, D), jnp.float32)
+    # contiguous segments with uneven boundaries
+    bounds = np.sort(
+        np.random.default_rng(seed).choice(
+            np.arange(8, S - 8), size=n_segs - 1, replace=False
+        )
+    )
+    seg_row = np.zeros(S, np.int32)
+    for b in bounds:
+        seg_row[b:] += 1
+    seg = jnp.asarray(np.stack([seg_row, (seg_row + 1) % n_segs + 10]))[:B]
+    return q, k, v, seg
+
+
+def _segwise_dense(q, k, v, seg, causal=True):
+    """Ground truth: dense attention run on each segment independently."""
+    out = np.zeros(q.shape, np.float32)
+    for b in range(q.shape[0]):
+        for s in np.unique(np.asarray(seg[b])):
+            idx = np.where(np.asarray(seg[b]) == s)[0]
+            o = default_attention(
+                q[b : b + 1, idx], k[b : b + 1, idx], v[b : b + 1, idx],
+                causal=causal,
+            )
+            out[b, idx] = np.asarray(o)[0]
+    return out
+
+
+def test_blockwise_segment_parity():
+    q, k, v, seg = _packed()
+    ref = _segwise_dense(q, k, v, seg)
+    out = blockwise_attention(q, k, v, causal=True, segment_ids=seg, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+    # and default_attention's own segment mask agrees
+    out2 = default_attention(q, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out2), ref, atol=2e-5)
+
+
+def test_xla_ring_segment_parity_sp4():
+    q, k, v, seg = _packed()
+    ref = _segwise_dense(q, k, v, seg)
+    mesh = _mesh(4)
+    with jax.set_mesh(mesh):
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=True, segment_ids=seg, impl="xla"
+        )
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_xla_ring_segment_grads_flow():
+    """Cross-segment grads must be exactly zero; within-segment nonzero."""
+    mesh = _mesh(2)
+    q, k, v, seg = _packed(B=1, S=32, H=2, KH=2, D=8, n_segs=2, seed=1)
+
+    def loss(q, k, v):
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=True, segment_ids=seg, impl="xla"
+        )
+        # loss reads only segment-0 outputs
+        m = (seg[0] == np.asarray(seg[0])[0]).astype(np.float32)
+        return (out[0] * m[:, None, None] ** 1).sum()
+
+    with jax.set_mesh(mesh):
+        gk = jax.grad(loss, argnums=1)(q, k, v)
+    seg0 = np.asarray(seg[0]) == np.asarray(seg[0])[0]
+    assert float(jnp.abs(gk[0, ~seg0]).max()) == 0.0
+    assert float(jnp.abs(gk[0, seg0]).max()) > 0.0
+
+
+def test_ulysses_segment_parity_sp4():
+    q, k, v, seg = _packed(H=4, KH=4)  # ulysses: n | H
+    ref = _segwise_dense(q, k, v, seg)
+    mesh = _mesh(4)
+    with jax.set_mesh(mesh):
+        out = ulysses_attention(
+            q, k, v, mesh=mesh, causal=True, segment_ids=seg
+        )
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_flash_kernel_segment_parity_and_grads():
+    """The Pallas kernel path (interpret machine) with in-kernel segment
+    masking: forward parity AND gradient parity vs the dense reference."""
+    q, k, v, seg = _packed(B=2, S=64, H=4, KH=2, D=128, n_segs=2, seed=2)
+    ref = _segwise_dense(q, k, v, seg)
+    out = flash_attention(
+        q, k, v, causal=True, segment_ids=seg, block_q=16, block_k=16,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, segment_ids=seg, block_q=16, block_k=16,
+            interpret=True,
+        )
+        return (o * jnp.cos(o)).sum()
+
+    def loss_dense(q, k, v):
+        o = default_attention(q, k, v, causal=True, segment_ids=seg)
+        return (o * jnp.cos(o)).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_decoder_trainer_packed_end_to_end():
+    """Packed batch {tokens, positions, segment_ids} through the Trainer on
+    the sp mesh: segment_ids reach ring attention, positions restart per
+    segment, the LM loss skips boundary targets, and loss decreases."""
+    import optax
+
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.parallel.ringattention import make_ring_attention
+    from maggy_tpu.parallel.spec import ShardingSpec
+    from maggy_tpu.train import TrainContext
+
+    ctx = TrainContext.create(ShardingSpec(sp=4, dp=2))
+    cfg = DecoderConfig.tiny(attention_fn=make_ring_attention(ctx.mesh))
+    rng = np.random.default_rng(0)
+    B, S = 4, 64
+    tokens = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    seg = np.zeros((B, S), np.int32)
+    seg[:, S // 2 :] = 1  # two packed docs per row
+    pos = np.concatenate(
+        [np.arange(S // 2), np.arange(S - S // 2)]
+    )[None].repeat(B, 0).astype(np.int32)
+    batch = {"tokens": tokens, "positions": pos, "segment_ids": seg}
+
+    trainer = ctx.trainer(Decoder(cfg), optax.adamw(1e-2))
+    state = trainer.make_state(jax.random.key(0), batch)
+    losses = []
+    for _ in range(5):
+        state, m = trainer.step(state, trainer.shard_batch(batch))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
