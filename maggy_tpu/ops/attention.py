@@ -75,6 +75,12 @@ def _finalize(acc: jax.Array, l: jax.Array, dtype) -> jax.Array:
     return out.transpose(0, 2, 1, 3).astype(dtype)  # [B,Q,H,D]
 
 
+# public surface for cross-module consumers (flash kernel, ring, KV-cache
+# decode) — same objects, stable contracts
+repeat_kv = _repeat_kv
+finalize = _finalize
+
+
 def init_carry(b: int, h: int, q: int, d: int):
     return (
         jnp.zeros((b, h, q, d), jnp.float32),

@@ -44,6 +44,33 @@ def test_incremental_matches_full_forward(setup):
     np.testing.assert_allclose(inc, full, atol=2e-2)  # bf16 accumulation noise
 
 
+def test_multi_chunk_cache_reads_match_full_forward():
+    """Length-adaptive chunked cache reads (decode_chunk < max_seq_len): the
+    cross-chunk online-softmax recurrence must reproduce the full forward —
+    geometry chosen so 4 chunks are live and the prefix crosses chunk
+    boundaries mid-decode (VERDICT r3 item 7 path, multi-chunk case)."""
+    cfg = DecoderConfig.tiny(max_seq_len=64, decode_chunk=16, dtype=jnp.float32)
+    model = Decoder(cfg)
+    tokens = jnp.asarray(
+        np.arange(56)[None, :] % cfg.vocab_size, dtype=jnp.int32
+    )
+    variables = model.init(jax.random.key(3), tokens)
+    decode_model = Decoder(dataclasses.replace(cfg, decode=True))
+    full = np.asarray(model.apply(variables, tokens))
+    cache = init_cache(decode_model, tokens)
+    outs = []
+    for p in range(tokens.shape[1]):
+        logits, mut = decode_model.apply(
+            {"params": variables["params"], "cache": cache},
+            tokens[:, p : p + 1],
+            jnp.full((1, 1), p, jnp.int32),
+            mutable=["cache"],
+        )
+        cache = mut["cache"]
+        outs.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(outs, axis=1), full, atol=2e-4)
+
+
 def test_cache_shapes_scanned(setup):
     cfg, _, decode_model, _, tokens = setup
     cache = init_cache(decode_model, tokens)

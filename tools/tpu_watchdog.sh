@@ -14,7 +14,9 @@ sys.exit(0 if backend_alive(150) else 1)
 "; then
     echo "$(date -u +%FT%TZ) tunnel ALIVE (probe $i); running bench" >> "$LOG"
     python bench.py > tools/bench_early_r4.json 2> tools/bench_early_r4.err
-    echo "$(date -u +%FT%TZ) bench rc=$? done" >> "$LOG"
+    echo "$(date -u +%FT%TZ) bench rc=$? done; running decode bench" >> "$LOG"
+    python tools/bench_decode.py > tools/bench_decode_r4.json 2> tools/bench_decode_r4.err
+    echo "$(date -u +%FT%TZ) decode bench rc=$? done" >> "$LOG"
     exit 0
   fi
   echo "$(date -u +%FT%TZ) probe $i dead; sleeping 420s" >> "$LOG"
