@@ -279,10 +279,28 @@ class Driver(ABC):
         if self._log_fd is not None:
             self._log_fd.close()
             self._log_fd = None
+        if getattr(self, "_remote_log", False) and self._log_history:
+            import posixpath
+
+            try:
+                self.env.dump(
+                    "\n".join(self._log_history) + "\n",
+                    posixpath.join(self.exp_dir, "maggy.log"),
+                )
+            except Exception:  # noqa: BLE001 - logs are best-effort
+                pass
+            self._log_history = []
 
     # ------------------------------------------------------------------ logging
 
     def _open_log(self) -> None:
+        # remote roots: object stores can't append — buffer and publish once
+        # at close() via the env seam (mirrors Reporter's executor logs)
+        self._remote_log = "://" in str(self.exp_dir)
+        self._log_history: List[str] = []
+        if self._remote_log:
+            self._log_fd = None
+            return
         try:
             self._log_fd = open(os.path.join(self.exp_dir, "maggy.log"), "a", buffering=1)
         except OSError:
@@ -294,6 +312,8 @@ class Driver(ABC):
             self.executor_logs.append(line)
             if self._log_fd is not None:
                 self._log_fd.write(line + "\n")
+            elif getattr(self, "_remote_log", False):
+                self._log_history.append(line)
         logger.info(message)
 
     def add_executor_logs(self, logs: List[str]) -> None:

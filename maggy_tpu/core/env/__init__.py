@@ -1,7 +1,8 @@
 """Environment singleton (reference core/environment/singleton.py:20-62).
 
-Selection: ``MAGGY_TPU_LOG_ROOT`` starting with ``gs://`` (or ``MAGGY_TPU_ENV=gcs``)
-picks the GCS environment; otherwise local filesystem.
+Selection: a ``MAGGY_TPU_LOG_ROOT`` with a URL scheme (``gs://``,
+``memory://``, any fsspec protocol — or ``MAGGY_TPU_ENV=gcs``) picks the
+cloud environment; otherwise local filesystem.
 """
 
 from __future__ import annotations
@@ -18,7 +19,9 @@ def get_instance() -> BaseEnv:
     global _instance
     if _instance is None:
         root = os.environ.get("MAGGY_TPU_LOG_ROOT", "")
-        if root.startswith("gs://") or os.environ.get("MAGGY_TPU_ENV") == "gcs":
+        # any URL scheme routes through fsspec (incl. file:// — fsspec's
+        # local driver handles it; BaseEnv would treat it as a literal path)
+        if "://" in root or os.environ.get("MAGGY_TPU_ENV") == "gcs":
             from maggy_tpu.core.env.gcs import GcsEnv
 
             _instance = GcsEnv(root or None)

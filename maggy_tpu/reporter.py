@@ -28,7 +28,15 @@ class Reporter:
         self._early_stop = False
         self._logs: List[str] = []
         self._log_file = log_file
-        self._log_fd = open(log_file, "a", buffering=1) if log_file else None
+        # remote roots (gs://, memory://): object stores can't append, so
+        # buffer the whole log and publish once at close() via the env seam
+        self._remote_log = bool(log_file) and "://" in str(log_file)
+        self._log_history: List[str] = []
+        self._log_fd = (
+            open(log_file, "a", buffering=1)
+            if log_file and not self._remote_log
+            else None
+        )
         self.partition_id = partition_id
         self.trial_id: Optional[str] = None
         self._print_hook = print_hook
@@ -95,6 +103,8 @@ class Reporter:
             self._logs.append(line)
             if self._log_fd:
                 self._log_fd.write(line.rstrip("\n") + "\n")
+            elif self._remote_log:
+                self._log_history.append(line.rstrip("\n"))
         if verbose and self._print_hook:
             self._print_hook(line)
 
@@ -103,3 +113,13 @@ class Reporter:
             if self._log_fd:
                 self._log_fd.close()
                 self._log_fd = None
+            if self._remote_log and self._log_history:
+                from maggy_tpu.core.env import EnvSing
+
+                try:
+                    EnvSing.get_instance().dump(
+                        "\n".join(self._log_history) + "\n", self._log_file
+                    )
+                except Exception:  # noqa: BLE001 - logs are best-effort
+                    pass
+                self._log_history = []

@@ -210,17 +210,25 @@ def persist_outputs(
     outputs: dict, metric: Optional[float], log_dir: Optional[str]
 ) -> None:
     """Write ``.outputs.json`` (+ ``.metric`` when one exists) into a trial/
-    worker dir; best-effort."""
+    worker dir; best-effort. Routed through the env seam so remote roots
+    (gs://, memory://) receive the artifacts instead of a literal local
+    'gs:/...' directory."""
     if not log_dir:
         return
+    import posixpath
+
+    from maggy_tpu.core.env import EnvSing
+
+    env = EnvSing.get_instance()
     try:
-        os.makedirs(log_dir, exist_ok=True)
-        with open(os.path.join(log_dir, constants.OUTPUTS_FILE), "w") as f:
-            json.dump(_jsonify(outputs), f, sort_keys=True)
+        env.mkdir(log_dir)
+        env.dump(
+            json.dumps(_jsonify(outputs), sort_keys=True),
+            posixpath.join(log_dir, constants.OUTPUTS_FILE),
+        )
         if metric is not None:
-            with open(os.path.join(log_dir, constants.METRIC_FILE), "w") as f:
-                f.write(repr(metric))
-    except OSError as e:
+            env.dump(repr(metric), posixpath.join(log_dir, constants.METRIC_FILE))
+    except Exception as e:  # noqa: BLE001 - cloud FS raise non-OSError types
         logging.getLogger(__name__).warning(
             "Could not persist trial outputs to %s: %s", log_dir, e
         )
