@@ -355,17 +355,21 @@ def _bwd_call(
 
 @functools.lru_cache(maxsize=None)
 def _flash_core(
-    causal: bool, block_q: int, block_k: int, group: int, heads: int,
-    interpret: bool, segmented: bool,
+    causal: bool, block_q: int, block_k: int, bwd_block_q: int,
+    bwd_block_k: int, group: int, heads: int, interpret: bool,
+    segmented: bool,
 ):
     """Differentiable flash attention on q [B*H, S, D], k/v [B*Kh, S, D]
     (GQA group = H // Kh handled by kernel index maps — the repeated K/V
     never exist, in HBM or as residuals). With ``segmented``, a fourth
     [B, S] int32 operand masks attention across packed-sequence
-    boundaries (zero cotangent)."""
+    boundaries (zero cotangent). Backward tiles are independent of the
+    forward's — the dq/dkv kernels hold 6+ operands per tile, so their VMEM
+    sweet spot can differ (tools/tune_flash.py sweeps both on silicon)."""
 
     kw = dict(causal=causal, block_q=block_q, block_k=block_k, group=group,
               heads=heads, interpret=interpret)
+    bwd_kw = dict(kw, block_q=bwd_block_q, block_k=bwd_block_k)
 
     @jax.custom_vjp
     def core(q, k, v, segs):
@@ -377,9 +381,16 @@ def _flash_core(
 
     def core_bwd(res, g):
         q, k, v, segs, o, lse = res
+        if bwd_block_q != block_q:
+            # the LSE residual is stored chunked by the FORWARD's q tile
+            # ([BH, n_q, block_q, 1], contiguous in sq) — re-chunk for the
+            # backward's tiling
+            bh_, _, _, _ = lse.shape
+            sq_ = q.shape[1]
+            lse = lse.reshape(bh_, sq_ // bwd_block_q, bwd_block_q, 1)
         dq, dk_h, dv_h = _bwd_call(
             q, k, v, o, g.astype(o.dtype), lse,
-            segs if segmented else None, **kw,
+            segs if segmented else None, **bwd_kw,
         )
         if group > 1:
             # dkv kernel emits per-q-head grads; sum each GQA group in fp32
@@ -413,7 +424,10 @@ def _auto_blocks(sq: int, sk: int) -> tuple:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=(
+        "causal", "block_q", "block_k", "bwd_block_q", "bwd_block_k", "interpret",
+    ),
 )
 def flash_attention(
     q: jax.Array,
@@ -423,28 +437,39 @@ def flash_attention(
     causal: bool = True,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     segment_ids=None,
 ) -> jax.Array:
     """q [B,S,H,D], k/v [B,S,Kh,D] → [B,S,H,D]. Differentiable (custom VJP).
     ``block_q``/``block_k`` default to the measured-fastest tiling for the
-    sequence length (``_auto_blocks``). ``segment_ids`` [B, S] masks
-    attention across packed-sequence boundaries in-kernel."""
+    sequence length (``_auto_blocks``); ``bwd_block_q``/``bwd_block_k``
+    default to the forward's and can be tuned independently (the backward
+    kernels carry 6+ operand tiles, so their VMEM sweet spot differs —
+    tools/tune_flash.py). ``segment_ids`` [B, S] masks attention across
+    packed-sequence boundaries in-kernel."""
     b, sq, h, d = q.shape
     kh = k.shape[2]
     sk = k.shape[1]
     auto_q, auto_k = _auto_blocks(sq, sk)
     block_q = min(block_q, sq) if block_q else auto_q
     block_k = min(block_k, sk) if block_k else auto_k
+    bwd_block_q = min(bwd_block_q, sq) if bwd_block_q else block_q
+    bwd_block_k = min(bwd_block_k, sk) if bwd_block_k else block_k
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     # fall back unless blocks tile evenly AND stay sublane-aligned (multiple
     # of 8 rows) — Mosaic cannot lower arbitrary-row tiles. Segment-id tiles
     # [1, block] put the block in the lane dim, so compiled (non-interpret)
     # segmented runs additionally need lane-aligned blocks.
-    unaligned = sq % block_q or sk % block_k or d % _LANES or block_q % 8 or block_k % 8
-    seg_unaligned = segment_ids is not None and not interpret and (
-        block_q % _LANES or block_k % _LANES
+    blocks = (block_q, block_k, bwd_block_q, bwd_block_k)
+    unaligned = (
+        sq % block_q or sk % block_k or sq % bwd_block_q or sk % bwd_block_k
+        or d % _LANES or any(bq % 8 for bq in blocks)
+    )
+    seg_unaligned = segment_ids is not None and not interpret and any(
+        bq % _LANES for bq in blocks
     )
     if unaligned or seg_unaligned:
         return blockwise_attention(
@@ -461,9 +486,10 @@ def flash_attention(
         if segmented
         else jnp.zeros((b, sq), jnp.int32)  # placeholder, never read
     )
-    out = _flash_core(causal, block_q, block_k, h // kh, h, interpret, segmented)(
-        qr, kr, vr, segs
-    )
+    out = _flash_core(
+        causal, block_q, block_k, bwd_block_q, bwd_block_k, h // kh, h,
+        interpret, segmented,
+    )(qr, kr, vr, segs)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 

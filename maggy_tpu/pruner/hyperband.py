@@ -43,16 +43,27 @@ class Hyperband(AbstractPruner):
         resource_min: float = 1,
         resource_max: float = 9,
         direction: str = "max",
+        iterations: int = 1,
     ):
+        """:param iterations: how many full Hyperband cycles to schedule
+        (hpbandster's ``n_iterations``; the reference runs SH iterations
+        concurrently, hyperband.py:137-195). With one cycle, a fleet larger
+        than the base rungs can sit IDLE behind straggler-gated promotions;
+        extra cycles keep every executor busy — later cycles' base rungs
+        stay eligible while earlier cycles wait on stragglers."""
         super().__init__(trial_metric_getter, direction)
         if eta < 2:
             raise ValueError("eta must be >= 2")
         if resource_min <= 0 or resource_max < resource_min:
             raise ValueError("need 0 < resource_min <= resource_max")
+        if iterations < 1:
+            raise ValueError("iterations must be >= 1")
         self.eta = int(eta)
         s_max = int(math.floor(math.log(resource_max / resource_min, eta) + 1e-9))
         self.brackets = [
-            _Bracket(s, s_max, self.eta, resource_max) for s in range(s_max, -1, -1)
+            _Bracket(s, s_max, self.eta, resource_max)
+            for _ in range(int(iterations))
+            for s in range(s_max, -1, -1)
         ]
         self._pending = None  # (rung, source_trial_id) awaiting report_trial
 

@@ -156,6 +156,30 @@ def test_flash_backward_matches_reference(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-2, rtol=2e-2)
 
 
+def test_flash_independent_bwd_tiles():
+    """bwd_block_q/bwd_block_k different from the forward's tiles: the LSE
+    residual re-chunks and gradients stay exact (the silicon tuning knob,
+    tools/tune_flash.py)."""
+    q, k, v = qkv(b=1, s=256, h=2, d=128)
+
+    def loss(bq, bk, bbq, bbk):
+        def f(q, k, v):
+            o = flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk,
+                bwd_block_q=bbq, bwd_block_k=bbk,
+            )
+            return (o ** 2).sum()
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    base = loss(128, 128, 128, 128)
+    mixed = loss(128, 128, 64, 32)   # smaller bwd tiles
+    wider = loss(64, 64, 128, 256)   # larger bwd tiles than fwd
+    for a, b in zip(base, mixed):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+    for a, b in zip(base, wider):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_flash_backward_gqa_bf16():
     """GQA grads sum back over the head group; bf16 within bf16 tolerance."""
     q, k, v = qkv(b=2, s=128, h=4, kh=2, d=128, dtype=jnp.bfloat16)

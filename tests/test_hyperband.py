@@ -64,6 +64,38 @@ def test_validation():
         Hyperband(lambda ids: {}, eta=1)
     with pytest.raises(ValueError):
         Hyperband(lambda ids: {}, resource_min=5, resource_max=2)
+    with pytest.raises(ValueError):
+        Hyperband(lambda ids: {}, iterations=0)
+
+
+def test_iterations_prevent_straggler_starvation():
+    """With iterations=2, a fleet blocked on cycle-1 stragglers keeps
+    getting fresh base-rung configs from cycle 2 instead of IDLE (the
+    reference's concurrent-SH-iterations throughput semantics,
+    hyperband.py:137-195)."""
+    finished = {}
+    hb = Hyperband(
+        lambda ids: {i: finished[i] for i in ids if i in finished},
+        eta=2, resource_min=1, resource_max=2, iterations=2,
+    )
+    assert hb.num_trials() == 2 * (2 + 1 + 2)
+    # fill cycle 1 completely (both brackets' base rungs)
+    for n in range(4):
+        d = hb.pruning_routine()
+        assert d["trial_id"] is None
+        hb.report_trial(None, f"c1_{n}")
+    # cycle 1's promotion is straggler-blocked, but cycle 2 must still yield
+    for n in range(4):
+        d = hb.pruning_routine()
+        assert d is not None and d != "IDLE", "second cycle starved"
+        assert d["trial_id"] is None
+        hb.report_trial(None, f"c2_{n}")
+    # now everything left is promotion slots behind stragglers -> IDLE
+    assert hb.pruning_routine() == "IDLE"
+    # cycle-1 stragglers finish: its promotion unblocks first
+    finished.update({"c1_0": 0.9, "c1_1": 0.2})
+    d = hb.pruning_routine()
+    assert d == {"trial_id": "c1_0", "budget": 2}
 
 
 def test_lagom_hyperband_e2e(tmp_env):
