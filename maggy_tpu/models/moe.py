@@ -180,9 +180,9 @@ class MoELayer(nn.Module):
     cfg: MoEConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         x = x + Attention(self.cfg, name="attn")(
-            RMSNorm(self.cfg, name="attn_norm")(x), positions
+            RMSNorm(self.cfg, name="attn_norm")(x), positions, segment_ids
         )
         x = x + MoEBlock(self.cfg, name="moe")(RMSNorm(self.cfg, name="mlp_norm")(x))
         return x
@@ -192,8 +192,8 @@ class _ScannedMoELayer(nn.Module):
     cfg: MoEConfig
 
     @nn.compact
-    def __call__(self, x, positions):
-        return MoELayer(self.cfg, name="layer")(x, positions), None
+    def __call__(self, x, positions, segment_ids=None):
+        return MoELayer(self.cfg, name="layer")(x, positions, segment_ids), None
 
 
 class MoEDecoder(nn.Module):
@@ -203,7 +203,7 @@ class MoEDecoder(nn.Module):
     cfg: MoEConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, segment_ids=None):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -229,13 +229,13 @@ class MoEDecoder(nn.Module):
                 layer_cls,
                 variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True},
-                in_axes=nn.broadcast,
+                in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: None},
-            )(cfg, name="layers")(x, positions)
+            )(cfg, name="layers")(x, positions, segment_ids)
         else:
             for i in range(cfg.n_layers):
-                x, _ = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+                x, _ = layer_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
 
         x = RMSNorm(cfg, name="final_norm")(x)
         logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, "lm_head")(x)

@@ -184,7 +184,36 @@ class Trainer:
             return init(rng, *jax.tree.map(np.asarray, inputs))
 
     def batch_shardings(self, batch):
-        return jax.tree.map(lambda _: shd.batch_sharding(self.mesh, self.rules), batch)
+        default = shd.batch_sharding(self.mesh, self.rules)
+        if not isinstance(batch, dict):
+            return jax.tree.map(lambda _: default, batch)
+        # packed-sequence side inputs are consumed seq-sharded by the SP
+        # attention shard_maps; placing them (batch, seq) up front avoids an
+        # XLA full-rematerialization reshard per step. Like params_shardings,
+        # degrade to the batch-only placement when the length doesn't divide
+        # the seq axis (non-SP attention paths have no divisibility demand)
+        seq_keys = ("segment_ids", "positions")
+        seq_ext = shd.mesh_extent(
+            self.mesh, shd.logical_to_mesh_axes(("activation_seq",), self.rules)[0]
+        )
+        seq_sharding = shd.named_sharding(
+            self.mesh, ("batch", "activation_seq"), self.rules
+        )
+
+        def pick(key, leaf):
+            if (
+                key in seq_keys
+                and seq_ext > 1
+                and getattr(leaf, "ndim", 0) >= 2
+                and leaf.shape[1] % seq_ext == 0
+            ):
+                return seq_sharding
+            return default
+
+        return {
+            k: jax.tree.map(lambda leaf, k=k: pick(k, leaf), v)
+            for k, v in batch.items()
+        }
 
     def shard_batch(self, batch, *, local: bool = False):
         """Place a host batch onto the mesh, batch axis over (data, fsdp).
