@@ -167,7 +167,15 @@ def _flatten(names):
 
 
 def default_dataset_generator(dataset: Any, ablated_feature: Optional[str]) -> Any:
-    """Drop one feature from a dict-of-arrays dataset; no-op for None."""
+    """Drop one feature from the dataset, schema-style (the reference edits
+    the feature-store TFRecord schema automatically, loco.py:41-80):
+
+    * dict-of-arrays — the key is dropped;
+    * ``ShardedDataset`` / ``ParquetShardedDataset`` — rebuilt with a
+      column list excluding the feature (no file rewrites: the loader just
+      stops reading that field/column);
+    * anything else — pass ``AblationStudy(dataset_generator=...)``.
+    """
     if ablated_feature is None or dataset is None:
         return dataset
     if isinstance(dataset, dict):
@@ -177,7 +185,25 @@ def default_dataset_generator(dataset: Any, ablated_feature: Optional[str]) -> A
                 f"{sorted(dataset)}"
             )
         return {k: v for k, v in dataset.items() if k != ablated_feature}
+    from maggy_tpu.train.sharded_dataset import (
+        ParquetShardedDataset,
+        ShardedDataset,
+    )
+
+    if isinstance(dataset, (ParquetShardedDataset, ShardedDataset)):
+        fields = dataset.fields
+        if ablated_feature not in fields:
+            raise KeyError(
+                f"Ablated feature {ablated_feature!r} not in dataset fields "
+                f"{sorted(fields)}"
+            )
+        keep = [f for f in fields if f != ablated_feature]
+        if not keep:
+            raise ValueError("Cannot ablate the only field of a dataset")
+        if isinstance(dataset, ParquetShardedDataset):
+            return ParquetShardedDataset(dataset.path, columns=keep)
+        return ShardedDataset(dataset.data_dir, columns=keep)
     raise TypeError(
-        "Default dataset generator handles dict datasets only; pass "
-        "AblationStudy(dataset_generator=...) for custom types."
+        "Default dataset generator handles dict and (Parquet)ShardedDataset "
+        "datasets; pass AblationStudy(dataset_generator=...) for custom types."
     )

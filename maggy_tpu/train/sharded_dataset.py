@@ -125,9 +125,12 @@ class _ShardLoaderMixin:
 
 
 class ShardedDataset(_ShardLoaderMixin):
-    """Handle on a sharded dataset directory (local path or Env-seam URL)."""
+    """Handle on a sharded dataset directory (local path or Env-seam URL).
 
-    def __init__(self, data_dir: str):
+    ``columns`` restricts the fields read (e.g. LOCO feature ablation drops
+    one column without touching the files)."""
+
+    def __init__(self, data_dir: str, columns: Optional[List[str]] = None):
         self.data_dir = data_dir
         self.fields = sorted(
             d for d in self._listdir(data_dir)
@@ -135,6 +138,15 @@ class ShardedDataset(_ShardLoaderMixin):
         )
         if not self.fields:
             raise ValueError(f"No field directories under {data_dir!r}")
+        if columns is not None:
+            if not columns:
+                raise ValueError("columns must be a non-empty list (or None)")
+            missing = [c for c in columns if c not in self.fields]
+            if missing:
+                raise ValueError(
+                    f"Columns {missing} not in dataset fields {self.fields}"
+                )
+            self.fields = sorted(columns)
         per_field = {}
         for f in self.fields:
             shards = sorted(
@@ -197,6 +209,8 @@ class ParquetShardedDataset(_ShardLoaderMixin):
     """
 
     def __init__(self, path: str, columns: Optional[List[str]] = None):
+        if columns is not None and not columns:
+            raise ValueError("columns must be a non-empty list (or None)")
         try:
             import pyarrow.parquet as pq
         except ImportError as e:  # pragma: no cover - env without pyarrow

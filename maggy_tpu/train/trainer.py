@@ -204,6 +204,11 @@ class Trainer:
             if (
                 key in seq_keys
                 and seq_ext > 1
+                # multi-process: every process passes the FULL sequence, so a
+                # process-spanning seq placement would make
+                # make_array_from_process_local_data misread the local length
+                # as one chunk; keep batch-only placement there
+                and jax.process_count() == 1
                 and getattr(leaf, "ndim", 0) >= 2
                 and leaf.shape[1] % seq_ext == 0
             ):

@@ -160,6 +160,40 @@ def test_auto_ablate_tiers():
     assert isinstance(p, ParamMaskedModel)
 
 
+def test_default_dataset_generator_streaming_datasets(tmp_path):
+    """Feature ablation on streaming datasets rebuilds a column-filtered
+    view — no file rewrites, schema-style like the reference's feature-store
+    drop (loco.py:41-80)."""
+    from maggy_tpu.ablation.ablationstudy import default_dataset_generator
+    from maggy_tpu.train.sharded_dataset import (
+        ParquetShardedDataset,
+        ShardedDataset,
+        write_parquet,
+        write_sharded,
+    )
+
+    data = {
+        "tokens": np.arange(32, dtype=np.int32).reshape(8, 4),
+        "extra": np.arange(8, dtype=np.int64),
+    }
+    write_sharded(str(tmp_path / "npy"), data, num_shards=2)
+    ds = ShardedDataset(str(tmp_path / "npy"))
+    dropped = default_dataset_generator(ds, "extra")
+    assert dropped.fields == ["tokens"]
+    assert next(dropped.loader(4, loop=False, shuffle=False)).keys() == {"tokens"}
+
+    pytest.importorskip("pyarrow")
+    write_parquet(str(tmp_path / "pq"), data, rows_per_group=4)
+    pq_ds = ParquetShardedDataset(str(tmp_path / "pq"))
+    pq_dropped = default_dataset_generator(pq_ds, "extra")
+    assert pq_dropped.fields == ["tokens"]
+
+    with pytest.raises(KeyError):
+        default_dataset_generator(ds, "nope")
+    with pytest.raises(ValueError):
+        default_dataset_generator(dropped, "tokens")  # only field left
+
+
 # ------------------------------------------------------------- driver e2e
 
 def test_loco_lagom_zero_factories():
