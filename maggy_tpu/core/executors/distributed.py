@@ -92,7 +92,12 @@ def dist_executor_fn(
             outputs = {}
             error = None
             try:
-                retval = train_fn(**kwargs)
+                # train_fn prints ship with the heartbeat logs, same as the
+                # trial executor (reference trial_executor.py:93-103)
+                from maggy_tpu.reporter import capture_prints
+
+                with capture_prints(reporter):
+                    retval = train_fn(**kwargs)
                 if retval is not None:
                     # per-worker dir: concurrent workers must not clobber
                     # outputs. The evaluator's outputs are free-form (no

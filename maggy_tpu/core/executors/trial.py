@@ -24,7 +24,7 @@ from maggy_tpu import constants, util
 from maggy_tpu.core import rpc
 from maggy_tpu.core.env import EnvSing
 from maggy_tpu.exceptions import EarlyStopException
-from maggy_tpu.reporter import Reporter
+from maggy_tpu.reporter import Reporter, capture_prints
 
 # keys stripped from trial params before they reach the train_fn as hparams
 # ("budget" stays available via the dedicated kwarg and in hparams for ASHA-style
@@ -123,7 +123,10 @@ def trial_executor_fn(
         error: Optional[str] = None
         early = False
         try:
-            retval = train_fn(**kwargs)
+            # train_fn prints ship to the driver with the heartbeat logs
+            # (reference trial_executor.py:93-103)
+            with capture_prints(reporter):
+                retval = train_fn(**kwargs)
             metric = util.handle_return_val(
                 retval, trial_dir, config.optimization_key
             )

@@ -10,12 +10,62 @@ lets early stopping interrupt a Python-level training loop between jitted steps
 
 from __future__ import annotations
 
+import builtins
+import contextlib
 import threading
 from typing import Any, List, Optional
 
 import numpy as np
 
 from maggy_tpu import exceptions
+
+# ---------------------------------------------------------------- print capture
+#
+# Reference parity: the trial executor hijacks ``print`` so a train_fn's
+# prints ship to the driver with the heartbeat logs
+# (trial_executor.py:93-103). The reference swaps builtins.print per Spark
+# task PROCESS; our executors are THREADS in one process, so the tee is
+# installed once and routes through a thread-local — concurrent trials
+# capture into their own reporters without racing on builtins.
+
+_print_local = threading.local()
+_orig_print = builtins.print
+_tee_installed = False
+_tee_lock = threading.Lock()
+
+
+def _tee_print(*args, **kwargs):
+    reporter = getattr(_print_local, "reporter", None)
+    if reporter is not None and kwargs.get("file") is None:
+        try:
+            reporter.log(
+                kwargs.get("sep", " ").join(str(a) for a in args), verbose=False
+            )
+        except Exception:  # noqa: BLE001 - printing must never raise
+            pass
+    _orig_print(*args, **kwargs)
+
+
+@contextlib.contextmanager
+def capture_prints(reporter: "Reporter"):
+    """Route this thread's ``print()`` calls into ``reporter.log`` (they
+    still reach stdout). Used around train_fn execution.
+
+    Scope note vs the reference's process-wide swap: only THIS thread's
+    prints are captured — threads a train_fn spawns itself (data loaders,
+    callbacks) go to stdout only. That's the price of running executors as
+    threads in one process; spawned workers should log via ``reporter``."""
+    global _tee_installed
+    with _tee_lock:
+        if not _tee_installed:
+            builtins.print = _tee_print
+            _tee_installed = True
+    prev = getattr(_print_local, "reporter", None)
+    _print_local.reporter = reporter
+    try:
+        yield
+    finally:
+        _print_local.reporter = prev
 
 
 class Reporter:

@@ -182,6 +182,44 @@ def test_lagom_single_experiment_guard(tmp_env):
         t.join(timeout=10)
 
 
+def test_lagom_train_fn_prints_ship_to_logs(tmp_env):
+    """A train_fn's plain print() must land in the executor's log plane
+    (reference hijacks builtins.print, trial_executor.py:93-103) — here via
+    the thread-local tee, so concurrent executor threads don't cross wires."""
+
+    def train(hparams, reporter):
+        print(f"printed-marker x={hparams['x']:.4f}")
+        reporter.broadcast(hparams["x"], step=0)
+        return hparams["x"]
+
+    cfg = HyperparameterOptConfig(
+        num_trials=4,
+        optimizer="randomsearch",
+        searchspace=space(),
+        direction="max",
+        num_executors=2,
+        es_policy="none",
+        hb_interval=0.05,
+        seed=0,
+    )
+    result = experiment.lagom(train, cfg)
+    assert result["num_trials"] == 4
+    root = tmp_env.root
+    app = next(a for a in os.listdir(root) if a.startswith("application_"))
+    run = sorted(os.listdir(os.path.join(root, app)))[0]
+    exp = os.path.join(root, app, run)
+    per_file = {}
+    for name in os.listdir(exp):
+        if name.startswith("executor_") and name.endswith(".log"):
+            with open(os.path.join(exp, name)) as f:
+                per_file[name] = f.read()
+    assert sum(t.count("printed-marker") for t in per_file.values()) == 4
+    # per-thread isolation: each executor's prints must sit in ITS OWN log
+    # next to that executor's trial lifecycle lines, not pooled in one file
+    busy = [t for t in per_file.values() if "printed-marker" in t]
+    assert len(busy) == 2, f"prints pooled into {len(busy)} file(s)"
+
+
 def test_lagom_injects_train_context(tmp_env):
     """A train_fn asking for ``ctx`` gets a lease-wide TrainContext (built
     lazily — metric-only train_fns never touch jax)."""
