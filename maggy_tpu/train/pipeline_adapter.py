@@ -149,6 +149,12 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         return logits.astype(jnp.float32)
 
     def _bcast(p):
+        import numpy as np
+
+        if isinstance(p, np.ndarray):
+            # host path (checkpoint re-staging): zero-copy view, never
+            # n_stages materialized copies on the default device
+            return np.broadcast_to(p[None], (n_stages,) + p.shape)
         return jnp.broadcast_to(p[None], (n_stages,) + p.shape)
 
     def restack(params):
@@ -188,3 +194,29 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         restack=restack,
         unstack=unstack,
     )
+
+
+def convert_pipeline_state(state, old_parts, new_parts):
+    """Re-stage a pipeline TrainState across pp degrees (checkpoint
+    portability, SURVEY §5.4): every stage-stacked tree in the state —
+    params and the optax mirrors (adam mu/nu, ...) — goes through
+    ``old_parts.unstack`` → ``new_parts.restack``; scalars (step, adam
+    count) pass through. Run the result through the NEW Trainer's
+    ``make_state``-born shardings — ``Trainer.adopt_state`` does both —
+    before stepping."""
+    pstruct = jax.tree_util.tree_structure(state.params)
+
+    def is_param_tree(x):
+        try:
+            return jax.tree_util.tree_structure(x) == pstruct
+        except Exception:
+            return False
+
+    def convert(x):
+        if is_param_tree(x):
+            return new_parts.restack(old_parts.unstack(x))
+        return x
+
+    new_params = convert(state.params)
+    new_opt = jax.tree.map(convert, state.opt_state, is_leaf=is_param_tree)
+    return state.replace(params=new_params, opt_state=new_opt)

@@ -127,24 +127,67 @@ class Trainer:
 
     # ------------------------------------------------------------------ state
 
+    def _init_fn(self) -> Callable:
+        if self.pp > 1:
+            parts = self._pipeline_parts()
+
+            def init_fn(rng, *ins):
+                variables = self.model.init(rng, *ins)
+                stage_params = parts.restack(shd.unbox(variables["params"]))
+                return TrainState.create(
+                    apply_fn=self.model.apply, params=stage_params, tx=self.optimizer
+                )
+        else:
+            def init_fn(rng, *ins):
+                variables = self.model.init(rng, *ins)
+                return TrainState.create(
+                    apply_fn=self.model.apply, params=variables["params"],
+                    tx=self.optimizer,
+                )
+
+        return init_fn
+
+    def state_shardings_for(self, sample_batch: Dict[str, Any], rng=None):
+        """Compute (and cache) every TrainState leaf's NamedSharding from
+        shapes alone — no allocation, no compile. ``make_state`` routes
+        through this; it also serves placing foreign states (restored
+        checkpoints, possibly re-staged across pp degrees) without a
+        throwaway init — see :meth:`adopt_state`."""
+        if rng is None:
+            rng = jax.random.key(0)  # shapes only; the key value is irrelevant
+        inputs = _model_inputs(sample_batch)
+        abstract = jax.eval_shape(self._init_fn(), rng, *inputs)
+        if self.pp > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            n_stages = self._pipeline_parts().n_stages
+
+            def shard_of(leaf):
+                # every stage-stacked leaf (params and the optax state
+                # mirroring them) leads with [n_stages]; the rest (step /
+                # adam count) are scalars — leading-dim == pp is exact here
+                if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_stages:
+                    return NamedSharding(self.mesh, P(AXIS_STAGE))
+                return NamedSharding(self.mesh, P())
+
+            self.state_shardings = jax.tree.map(shard_of, abstract)
+        else:
+            self.state_shardings = shd.params_shardings(
+                self.mesh, abstract, self.rules
+            )
+        return self.state_shardings
+
     def make_state(self, rng: jax.Array, sample_batch: Dict[str, Any]) -> TrainState:
         """Initialize a TrainState with every leaf born on its target devices
         (jit + out_shardings — no host-side full materialization). Under a
         ``stage`` mesh axis > 1 the params are born in the stage-stacked
         pipeline layout (see :mod:`maggy_tpu.train.pipeline_adapter`)."""
-        if self.pp > 1:
-            return self._make_state_pp(rng, sample_batch)
         inputs = _model_inputs(sample_batch)
-
-        def init_fn(rng, *ins):
-            variables = self.model.init(rng, *ins)
-            return TrainState.create(
-                apply_fn=self.model.apply, params=variables["params"], tx=self.optimizer
-            )
-
-        abstract = jax.eval_shape(init_fn, rng, *inputs)
-        self.state_shardings = shd.params_shardings(self.mesh, abstract, self.rules)
-        init = jax.jit(init_fn, out_shardings=self.state_shardings)
+        init = jax.jit(
+            self._init_fn(),
+            out_shardings=self.state_shardings_for(sample_batch, rng),
+        )
         import numpy as np
 
         # np (not jnp): host values enter a multi-process jit as replicated
@@ -152,36 +195,16 @@ class Trainer:
         with self.mesh:
             return init(rng, *jax.tree.map(np.asarray, inputs))
 
-    def _make_state_pp(self, rng: jax.Array, sample_batch: Dict[str, Any]) -> TrainState:
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
-
-        parts = self._pipeline_parts()
-        inputs = _model_inputs(sample_batch)
-
-        def init_fn(rng, *ins):
-            variables = self.model.init(rng, *ins)
-            stage_params = parts.restack(shd.unbox(variables["params"]))
-            return TrainState.create(
-                apply_fn=self.model.apply, params=stage_params, tx=self.optimizer
-            )
-
-        abstract = jax.eval_shape(init_fn, rng, *inputs)
-
-        def shard_of(leaf):
-            # every stage-stacked leaf (params and the optax state mirroring
-            # them) leads with [n_stages]; the rest (step / adam count) are
-            # scalars — so leading-dim == pp is exact here, not a heuristic
-            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == parts.n_stages:
-                return NamedSharding(self.mesh, P(AXIS_STAGE))
-            return NamedSharding(self.mesh, P())
-
-        self.state_shardings = jax.tree.map(shard_of, abstract)
-        init = jax.jit(init_fn, out_shardings=self.state_shardings)
-        import numpy as np
-
+    def adopt_state(self, state: TrainState, sample_batch: Dict[str, Any]) -> TrainState:
+        """Place a foreign/host TrainState onto THIS trainer's mesh layout —
+        e.g. a checkpoint restored elsewhere or re-staged across pp degrees
+        via :func:`maggy_tpu.train.pipeline_adapter.convert_pipeline_state`.
+        Rebinds apply_fn/optimizer to this trainer's (required for the
+        sharding tree's static fields to match) and shards every leaf."""
+        shardings = self.state_shardings_for(sample_batch)
+        state = state.replace(apply_fn=self.model.apply, tx=self.optimizer)
         with self.mesh:
-            return init(rng, *jax.tree.map(np.asarray, inputs))
+            return jax.device_put(state, shardings)
 
     def batch_shardings(self, batch):
         default = shd.batch_sharding(self.mesh, self.rules)
