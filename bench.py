@@ -33,9 +33,12 @@ SNAPSHOT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     """The TPU tunnel can wedge so that jax.devices() hangs forever; probe it
     in a subprocess first and fall back to CPU so the bench always completes
-    and reports what it ran on. Returns True when the fallback engaged."""
-    from maggy_tpu.util import backend_alive, force_cpu
+    and reports what it ran on. Returns True when the fallback engaged.
+    An explicit JAX_PLATFORMS=cpu request pins through force_cpu (the tunnel
+    plugin can hang even env-pinned processes at backend init)."""
+    from maggy_tpu.util import backend_alive, force_cpu, pin_cpu_if_requested
 
+    pin_cpu_if_requested()
     if backend_alive(probe_timeout):
         return False
     os.environ["XLA_FLAGS"] = (
