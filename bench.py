@@ -35,10 +35,13 @@ def ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     in a subprocess first and fall back to CPU so the bench always completes
     and reports what it ran on. Returns True when the fallback engaged.
     An explicit JAX_PLATFORMS=cpu request pins through force_cpu (the tunnel
-    plugin can hang even env-pinned processes at backend init)."""
+    plugin can hang even env-pinned processes at backend init) and counts as
+    the CPU fallback — the full accelerator geometry makes no sense there."""
     from maggy_tpu.util import backend_alive, force_cpu, pin_cpu_if_requested
 
-    pin_cpu_if_requested()
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        pin_cpu_if_requested()
+        return True
     if backend_alive(probe_timeout):
         return False
     os.environ["XLA_FLAGS"] = (
