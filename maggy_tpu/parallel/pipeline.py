@@ -144,11 +144,17 @@ def pipeline_grads_1f1b(
     mesh,
     axis_name: str = AXIS_STAGE,
     first_fn: Optional[Callable] = None,
+    stage_takes_raw: bool = False,
 ):
     """One training step with the 1F1B schedule: returns ``(loss, grads)``.
 
-    :param stage_fn: ``fn(params_for_one_stage, x) -> y``, activation-shape and
-        dtype preserving.
+    :param stage_fn: ``fn(params_for_one_stage, x) -> y``, activation-shape
+        and dtype preserving — or ``fn(params, x, raw)`` with
+        ``stage_takes_raw=True``: every stage also receives the CURRENT
+        microbatch's raw rows from the (stage-replicated) stream, so
+        side-channel inputs every layer needs — packed-sequence segment ids,
+        per-segment positions — reach all stages without flowing through the
+        activation hand-offs.
     :param loss_fn: ``fn(params_for_one_stage, y_final, target) -> scalar`` —
         mean loss of ONE microbatch, computed on the last stage only (no
         output buffer ever forms, let alone gets broadcast). Taking the stage
@@ -178,13 +184,16 @@ def pipeline_grads_1f1b(
     """
     if first_fn is None:
         first_fn = lambda params, raw: raw  # noqa: E731 - identity ingest
+    run_stage = (
+        stage_fn if stage_takes_raw else (lambda p, x, raw: stage_fn(p, x))
+    )
     S = mesh.shape[axis_name]
     M = microbatches.shape[0]
     if S == 1:
         def loss_all(params):
             p0 = jax.tree.map(lambda q: q[0], params)
             losses = jax.vmap(
-                lambda x, t: loss_fn(p0, stage_fn(p0, first_fn(p0, x)), t)
+                lambda x, t: loss_fn(p0, run_stage(p0, first_fn(p0, x), x), t)
             )(microbatches, targets)
             return losses.mean()
 
@@ -257,7 +266,7 @@ def pipeline_grads_1f1b(
             ring_f = jax.lax.dynamic_index_in_dim(xbuf, mf % RING, keepdims=False)
             y = jax.lax.cond(
                 do_f,
-                lambda raw, xr: stage_fn(params, ingest(params, raw, xr)),
+                lambda raw, xr: run_stage(params, ingest(params, raw, xr), raw),
                 lambda raw, xr: zeros_mb,
                 raw_f, ring_f,
             )
@@ -276,7 +285,9 @@ def pipeline_grads_1f1b(
             def run_bwd(raw, xr, g):
                 def last_fn(raw, xr, g):
                     lval, pull = jax.vjp(
-                        lambda p, x: loss_fn(p, stage_fn(p, ingest(p, raw, x)), tgt),
+                        lambda p, x: loss_fn(
+                            p, run_stage(p, ingest(p, raw, x), raw), tgt
+                        ),
                         params, xr,
                     )
                     dp, dx = pull(jnp.ones_like(lval))
@@ -284,7 +295,8 @@ def pipeline_grads_1f1b(
 
                 def mid_fn(raw, xr, g):
                     yv, pull = jax.vjp(
-                        lambda p, x: stage_fn(p, ingest(p, raw, x)), params, xr
+                        lambda p, x: run_stage(p, ingest(p, raw, x), raw),
+                        params, xr,
                     )
                     dp, dx = pull(g.astype(yv.dtype))
                     return dp, dx, jnp.float32(0)

@@ -60,8 +60,8 @@ class DecoderPipelineParts:
 
     n_stages: int
     layers_per_stage: int
-    first_fn: Callable  # (stage_params, tokens [mb,S]) -> x [mb,S,D]
-    stage_fn: Callable  # (stage_params, x) -> x (layer chunk)
+    first_fn: Callable  # (stage_params, raw [mb,S] | [mb,S,3]) -> x [mb,S,D]
+    stage_fn: Callable  # (stage_params, x, raw) -> x (layer chunk)
     head_fn: Callable   # (stage_params, x) -> logits [mb,S,V] fp32
     restack: Callable   # canonical decoder params -> stage-stacked tree
     unstack: Callable   # stage-stacked tree -> canonical decoder params
@@ -125,14 +125,28 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         metadata_params={nn.PARTITION_NAME: None},
     )(stage_cfg)
 
-    def first_fn(params, tokens):
+    # raw microbatch layouts (decided per-trace by ndim/width): [mb, S]
+    # plain tokens; [mb, S, 2] (tokens, positions); [mb, S, 3] (tokens,
+    # positions, segment_ids) — the 1F1B stream is stage-replicated, so
+    # every stage derives its side inputs from `raw` without widening the
+    # activation hand-offs
+
+    def first_fn(params, raw):
+        tokens = raw[..., 0] if raw.ndim == 3 else raw
         return jnp.asarray(params["embedding"], cfg.dtype)[tokens]
 
-    def stage_fn(params, x):
-        positions = jnp.broadcast_to(
-            jnp.arange(x.shape[1], dtype=jnp.int32), x.shape[:2]
+    def stage_fn(params, x, raw):
+        if raw.ndim == 3:
+            positions = raw[..., 1]
+            segment_ids = raw[..., 2] if raw.shape[-1] >= 3 else None
+        else:
+            positions = jnp.broadcast_to(
+                jnp.arange(x.shape[1], dtype=jnp.int32), x.shape[:2]
+            )
+            segment_ids = None
+        y, _ = chunk.apply(
+            {"params": params["layers"]}, x, positions, segment_ids
         )
-        y, _ = chunk.apply({"params": params["layers"]}, x, positions)
         return y
 
     # the head reuses the SAME modules as Decoder (single source of truth):

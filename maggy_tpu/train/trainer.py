@@ -296,15 +296,6 @@ class Trainer:
         dpf = shape.get(shd.AXIS_DATA, 1) * shape.get(shd.AXIS_FSDP, 1)
 
         def train_step(state: TrainState, batch):
-            if isinstance(batch, dict) and (
-                "segment_ids" in batch or "positions" in batch
-            ):
-                raise ValueError(
-                    "packed sequences (segment_ids/positions) are not "
-                    "supported under pp>1 yet — the stage adapter would "
-                    "silently use default arange positions; drop the stage "
-                    "axis or unpack the batch"
-                )
             tokens = _model_inputs(batch)[0]
             bsz = tokens.shape[0]
             if bsz % n_micro:
@@ -322,34 +313,72 @@ class Trainer:
             def split(a):
                 return a.reshape((n_micro, bsz // n_micro) + a.shape[1:])
 
+            def eff_mask(b):
+                """lm_loss_fn's effective target mask for a (sub)batch:
+                loss_mask AND same-segment — must mirror lm_loss_fn exactly
+                so the rescale below cancels its local denominator."""
+                t = _model_inputs(b)[0]
+                m = None
+                lm = b.get("loss_mask")
+                if lm is not None:
+                    m = lm[:, 1:].astype(jnp.float32)
+                sg = b.get("segment_ids")
+                if sg is not None:
+                    same = (sg[:, 1:] == sg[:, :-1]).astype(jnp.float32)
+                    m = same if m is None else m * same
+                return m
+
             tgts = jax.tree.map(split, batch)
             mask_norm = None
-            if self.loss_fn is lm_loss_fn and isinstance(batch, dict) and "loss_mask" in batch:
-                # global mask sum, for rescaling per-microbatch masked means
-                # back to the dense objective (docstring above)
-                mask_norm = jnp.maximum(
-                    batch["loss_mask"][:, 1:].astype(jnp.float32).sum(), 1.0
-                )
+            if self.loss_fn is lm_loss_fn and isinstance(batch, dict):
+                m = eff_mask(batch)
+                if m is not None:
+                    # global effective-mask sum, for rescaling per-microbatch
+                    # masked means back to the dense objective (docstring
+                    # above) — segment boundaries count too, or microbatches
+                    # with uneven packing would be mis-weighted
+                    mask_norm = jnp.maximum(m.sum(), 1.0)
 
             def loss_pp(stage_params, y, tgt):
                 loss = self.loss_fn(parts.head_fn(stage_params, y), tgt)
                 if mask_norm is not None:
-                    local = jnp.maximum(
-                        tgt["loss_mask"][:, 1:].astype(jnp.float32).sum(), 1.0
-                    )
+                    local = jnp.maximum(eff_mask(tgt).sum(), 1.0)
                     # primitive divides the psum of these by dpf*n_micro;
                     # this rescale makes the total sum(ll*mask)/global_sum
                     loss = loss * local * (dpf * n_micro) / mask_norm
                 return loss
 
+            if isinstance(batch, dict) and (
+                "segment_ids" in batch or "positions" in batch
+            ):
+                # packed sequences: side inputs ride the raw stream as int
+                # channels so every stage can mask/position its attention.
+                # positions-only batches stack 2 channels — a zeros
+                # segment-id channel would needlessly disable the flash
+                # kernel's segment_ids-is-None fast path
+                positions = batch.get("positions")
+                if positions is None:
+                    positions = jnp.broadcast_to(
+                        jnp.arange(tokens.shape[1], dtype=tokens.dtype),
+                        tokens.shape,
+                    )
+                channels = [tokens, positions.astype(tokens.dtype)]
+                seg = batch.get("segment_ids")
+                if seg is not None:
+                    channels.append(seg.astype(tokens.dtype))
+                raw = jnp.stack(channels, axis=-1)
+            else:
+                raw = tokens
+
             loss, grads = pipeline_grads_1f1b(
                 parts.stage_fn,
                 loss_pp,
                 state.params,
-                split(tokens),
+                split(raw),
                 tgts,
                 mesh=self.mesh,
                 first_fn=parts.first_fn,
+                stage_takes_raw=True,
             )
             new_state = state.apply_gradients(grads=grads)
             zero = jnp.zeros((), jnp.float32)
