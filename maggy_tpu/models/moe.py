@@ -23,6 +23,8 @@ from maggy_tpu.models.transformer import (
     DecoderConfig,
     RMSNorm,
     _dense,
+    _parse_ablated,
+    _partitioned,
 )
 
 
@@ -82,7 +84,7 @@ class MoEBlock(nn.Module):
     cfg: MoEConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, aux_gate=None):
         cfg = self.cfg
         b, s, d = x.shape
         t = b * s
@@ -130,25 +132,19 @@ class MoEBlock(nn.Module):
 
         w_gate = self.param(
             "w_gate",
-            nn.with_partitioning(
-                nn.initializers.normal(0.02), ("expert", "embed", "mlp")
-            ),
+            _partitioned(nn.initializers.normal(0.02), ("expert", "embed", "mlp"), cfg),
             (e, d, cfg.d_ff),
             cfg.param_dtype,
         )
         w_up = self.param(
             "w_up",
-            nn.with_partitioning(
-                nn.initializers.normal(0.02), ("expert", "embed", "mlp")
-            ),
+            _partitioned(nn.initializers.normal(0.02), ("expert", "embed", "mlp"), cfg),
             (e, d, cfg.d_ff),
             cfg.param_dtype,
         )
         w_down = self.param(
             "w_down",
-            nn.with_partitioning(
-                nn.initializers.normal(0.02), ("expert", "mlp", "embed")
-            ),
+            _partitioned(nn.initializers.normal(0.02), ("expert", "mlp", "embed"), cfg),
             (e, cfg.d_ff, d),
             cfg.param_dtype,
         )
@@ -168,10 +164,13 @@ class MoEBlock(nn.Module):
             y = y[:t]
         y = y.reshape(b, s, d)
 
-        # load-balancing auxiliary loss (Switch/Mixtral style)
+        # load-balancing auxiliary loss (Switch/Mixtral style); a LOCO gate
+        # scales it too, so ablated blocks add no balancing gradients
         me = router_probs.reshape(-1, e).mean(0)  # [e] mean router prob
         ce = jax.nn.one_hot(expert_idx[..., 0], e).reshape(-1, e).mean(0)
         aux = (me * ce).sum() * e * cfg.router_aux_weight
+        if aux_gate is not None:
+            aux = aux * aux_gate.astype(aux.dtype)
         self.sow("intermediates", "router_aux_loss", aux)
         return y
 
@@ -180,11 +179,21 @@ class MoELayer(nn.Module):
     cfg: MoEConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
-        x = x + Attention(self.cfg, name="attn")(
+    def __call__(self, x, positions, segment_ids=None, gates=None):
+        """``gates`` — optional [2] float (attn, moe) LOCO ablation gates,
+        same semantics as DecoderLayer (zero gate = identity residual,
+        zero grads, unchanged param tree). The gate also scales the sown
+        router aux loss — an ablated expert block must not keep pushing
+        balancing gradients into its router."""
+        a = Attention(self.cfg, name="attn")(
             RMSNorm(self.cfg, name="attn_norm")(x), positions, segment_ids
         )
-        x = x + MoEBlock(self.cfg, name="moe")(RMSNorm(self.cfg, name="mlp_norm")(x))
+        x = x + (a if gates is None else a * gates[0].astype(a.dtype))
+        m = MoEBlock(self.cfg, name="moe")(
+            RMSNorm(self.cfg, name="mlp_norm")(x),
+            aux_gate=None if gates is None else gates[1],
+        )
+        x = x + (m if gates is None else m * gates[1].astype(m.dtype))
         return x
 
 
@@ -194,6 +203,18 @@ class _ScannedMoELayer(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         return MoELayer(self.cfg, name="layer")(x, positions, segment_ids), None
+
+
+class _ScannedGatedMoELayer(nn.Module):
+    """Scan body when LOCO gates are active (gates ride in_axes=0)."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, positions, gates, segment_ids=None):
+        return MoELayer(self.cfg, name="layer")(
+            x, positions, segment_ids, gates
+        ), None
 
 
 class MoEDecoder(nn.Module):
@@ -211,13 +232,14 @@ class MoEDecoder(nn.Module):
             )
         embed = self.param(
             "embedding",
-            nn.with_partitioning(nn.initializers.normal(1.0), ("vocab", "embed")),
+            _partitioned(nn.initializers.normal(1.0), ("vocab", "embed"), cfg),
             (cfg.vocab_size, cfg.d_model),
             cfg.param_dtype,
         )
         x = jnp.asarray(embed, cfg.dtype)[tokens]
 
-        layer_cls = _ScannedMoELayer
+        gates = _parse_ablated(cfg.ablated, cfg.n_layers)
+        layer_cls = _ScannedMoELayer if gates is None else _ScannedGatedMoELayer
         if cfg.remat and not cfg.decode:  # no gradients (hence no remat) in decode
             layer_cls = nn.remat(
                 layer_cls,
@@ -225,17 +247,30 @@ class MoEDecoder(nn.Module):
                 policy=REMAT_POLICIES[cfg.remat_policy],
             )
         if cfg.scan_layers:
-            x, _ = nn.scan(
+            scanned = nn.scan(
                 layer_cls,
                 variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True},
-                in_axes=(nn.broadcast, nn.broadcast),
+                in_axes=(
+                    (nn.broadcast, nn.broadcast)
+                    if gates is None
+                    else (nn.broadcast, 0, nn.broadcast)
+                ),
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: None},
-            )(cfg, name="layers")(x, positions, segment_ids)
+            )(cfg, name="layers")
+            if gates is None:
+                x, _ = scanned(x, positions, segment_ids)
+            else:
+                x, _ = scanned(x, positions, jnp.asarray(gates), segment_ids)
         else:
             for i in range(cfg.n_layers):
-                x, _ = layer_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+                if gates is None:
+                    x, _ = layer_cls(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+                else:
+                    x, _ = layer_cls(cfg, name=f"layers_{i}")(
+                        x, positions, jnp.asarray(gates[i]), segment_ids
+                    )
 
         x = RMSNorm(cfg, name="final_norm")(x)
         logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, "lm_head")(x)

@@ -145,6 +145,7 @@ def pipeline_grads_1f1b(
     axis_name: str = AXIS_STAGE,
     first_fn: Optional[Callable] = None,
     stage_takes_raw: bool = False,
+    stage_has_aux: bool = False,
 ):
     """One training step with the 1F1B schedule: returns ``(loss, grads)``.
 
@@ -172,6 +173,13 @@ def pipeline_grads_1f1b(
         language model. Differentiated together with stage 0's chunk, so
         embedding gradients come out in stage 0's param grads. When None the
         microbatches themselves must already be activations.
+    :param stage_has_aux: the stage function returns ``(y, aux_scalar)`` —
+        a per-stage auxiliary loss (MoE router balancing). Each stage's aux
+        joins the objective at ITS OWN backward tick: the VJP is pulled with
+        cotangent ``(g, 1.0)`` so aux gradients land in that stage's param
+        grads. The return gains a third element: ``(loss, grads, aux)`` with
+        ``loss`` the DATA loss and ``aux`` the summed auxiliary term (both
+        microbatch means) — the optimized objective is their sum.
     :returns: ``loss`` — mean over all microbatches (replicated), and
         ``grads`` — same structure/sharding as ``stage_params``.
 
@@ -184,20 +192,32 @@ def pipeline_grads_1f1b(
     """
     if first_fn is None:
         first_fn = lambda params, raw: raw  # noqa: E731 - identity ingest
-    run_stage = (
+    base_stage = (
         stage_fn if stage_takes_raw else (lambda p, x, raw: stage_fn(p, x))
     )
+    if stage_has_aux:
+        run_stage = base_stage  # already (y, aux)
+    else:
+        run_stage = lambda p, x, raw: (base_stage(p, x, raw), jnp.float32(0))  # noqa: E731
     S = mesh.shape[axis_name]
     M = microbatches.shape[0]
     if S == 1:
         def loss_all(params):
             p0 = jax.tree.map(lambda q: q[0], params)
-            losses = jax.vmap(
-                lambda x, t: loss_fn(p0, run_stage(p0, first_fn(p0, x), x), t)
-            )(microbatches, targets)
-            return losses.mean()
 
-        return jax.value_and_grad(loss_all)(stage_params)
+            def one(x, t):
+                y, aux = run_stage(p0, first_fn(p0, x), x)
+                return loss_fn(p0, y, t), aux
+
+            data, aux = jax.vmap(one)(microbatches, targets)
+            return data.mean() + aux.mean(), (data.mean(), aux.mean())
+
+        (_, (data, aux)), grads = jax.value_and_grad(loss_all, has_aux=True)(
+            stage_params
+        )
+        if stage_has_aux:
+            return data, grads, aux
+        return data, grads
     if M < S:
         raise ValueError(
             f"Need at least as many microbatches ({M}) as stages ({S})."
@@ -244,7 +264,7 @@ def pipeline_grads_1f1b(
             return tb // 2, (tb >= 0) & (tb % 2 == 0) & (tb // 2 < M)
 
         def tick(carry, t):
-            xbuf, y_recv, g_recv, grad_acc, loss_acc = carry
+            xbuf, y_recv, g_recv, grad_acc, loss_acc, aux_acc = carry
 
             # 1. bank last tick's arriving activation into the ring
             m_arr, ok_arr = fwd_micro(t - 1, stage - 1)
@@ -266,7 +286,7 @@ def pipeline_grads_1f1b(
             ring_f = jax.lax.dynamic_index_in_dim(xbuf, mf % RING, keepdims=False)
             y = jax.lax.cond(
                 do_f,
-                lambda raw, xr: run_stage(params, ingest(params, raw, xr), raw),
+                lambda raw, xr: run_stage(params, ingest(params, raw, xr), raw)[0],
                 lambda raw, xr: zeros_mb,
                 raw_f, ring_f,
             )
@@ -284,36 +304,42 @@ def pipeline_grads_1f1b(
 
             def run_bwd(raw, xr, g):
                 def last_fn(raw, xr, g):
-                    lval, pull = jax.vjp(
-                        lambda p, x: loss_fn(
-                            p, run_stage(p, ingest(p, raw, x), raw), tgt
-                        ),
-                        params, xr,
-                    )
-                    dp, dx = pull(jnp.ones_like(lval))
-                    return dp, dx, lval.astype(jnp.float32)
+                    def full(p, x):
+                        y, aux = run_stage(p, ingest(p, raw, x), raw)
+                        return loss_fn(p, y, tgt), aux
+
+                    (lval, aux), pull = jax.vjp(full, params, xr)
+                    # both outputs get cotangent 1: loss + aux is the
+                    # optimized objective; they stay split for reporting
+                    dp, dx = pull((jnp.ones_like(lval), jnp.ones_like(aux)))
+                    return dp, dx, lval.astype(jnp.float32), aux.astype(jnp.float32)
 
                 def mid_fn(raw, xr, g):
-                    yv, pull = jax.vjp(
+                    (yv, aux), pull = jax.vjp(
                         lambda p, x: run_stage(p, ingest(p, raw, x), raw),
                         params, xr,
                     )
-                    dp, dx = pull(g.astype(yv.dtype))
-                    return dp, dx, jnp.float32(0)
+                    # cotangent 1.0 on the aux output: this stage's router
+                    # losses reach its param grads right here
+                    dp, dx = pull((g.astype(yv.dtype), jnp.ones_like(aux)))
+                    return dp, dx, jnp.float32(0), aux.astype(jnp.float32)
 
                 return jax.lax.cond(is_last, last_fn, mid_fn, raw, xr, g)
 
             def skip_bwd(raw, xr, g):
-                return zero_dp, zeros_mb, jnp.float32(0)
+                return zero_dp, zeros_mb, jnp.float32(0), jnp.float32(0)
 
-            dp, dx, lval = jax.lax.cond(do_b, run_bwd, skip_bwd, raw_b, ring_b, g_recv)
+            dp, dx, lval, aval = jax.lax.cond(
+                do_b, run_bwd, skip_bwd, raw_b, ring_b, g_recv
+            )
             grad_acc = jax.tree.map(lambda a, d: a + d, grad_acc, dp)
             loss_acc = loss_acc + lval
+            aux_acc = aux_acc + aval
 
             # 4. hand off: activations forward, gradients backward
             y_next = jax.lax.ppermute(y, axis_name, fwd_perm)
             g_next = jax.lax.ppermute(dx, axis_name, bwd_perm)
-            return (xbuf, y_next, g_next, grad_acc, loss_acc), None
+            return (xbuf, y_next, g_next, grad_acc, loss_acc, aux_acc), None
 
         init = (
             jnp.zeros((RING,) + act.shape, act.dtype),
@@ -321,13 +347,16 @@ def pipeline_grads_1f1b(
             zeros_mb,
             zero_dp,
             jnp.float32(0),
+            jnp.float32(0),
         )
-        (_, _, _, grad_acc, loss_acc), _ = jax.lax.scan(
+        (_, _, _, grad_acc, loss_acc, aux_acc), _ = jax.lax.scan(
             tick, init, jnp.arange(T)
         )
 
-        # data-parallel mean over (data, fsdp) replicas, micro mean over M;
-        # loss lives on the last stage only — psum over stage broadcasts it
+        # data-parallel mean over (data, fsdp) replicas, micro mean over M.
+        # the stage psums are load-bearing SUMS, not broadcasts: the data
+        # loss sits on the last stage, but every stage contributes its own
+        # aux at its backward ticks
         dpf = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
         grads = jax.tree.map(
             lambda g: (
@@ -335,18 +364,24 @@ def pipeline_grads_1f1b(
             )[None],
             grad_acc,
         )
-        loss = jax.lax.psum(loss_acc, axis_name)
-        loss = jax.lax.psum(loss, (AXIS_DATA, AXIS_FSDP)) / (dpf * M)
-        return loss, grads
+
+        def reduce_scalar(v):
+            v = jax.lax.psum(v, axis_name)
+            return jax.lax.psum(v, (AXIS_DATA, AXIS_FSDP)) / (dpf * M)
+
+        return reduce_scalar(loss_acc), grads, reduce_scalar(aux_acc)
 
     batch_spec = P(None, (AXIS_DATA, AXIS_FSDP))
-    return jax.shard_map(
+    loss, grads, aux = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis_name), batch_spec, batch_spec),
-        out_specs=(P(), P(axis_name)),
+        out_specs=(P(), P(axis_name), P()),
         check_vma=False,
     )(stage_params, microbatches, targets)
+    if stage_has_aux:
+        return loss, grads, aux
+    return loss, grads
 
 
 def stack_stage_params(per_layer_params, n_stages: int):

@@ -61,10 +61,13 @@ class DecoderPipelineParts:
     n_stages: int
     layers_per_stage: int
     first_fn: Callable  # (stage_params, raw [mb,S] | [mb,S,3]) -> x [mb,S,D]
-    stage_fn: Callable  # (stage_params, x, raw) -> x (layer chunk)
+    stage_fn: Callable  # (stage_params, x, raw) -> x  (or (x, aux))
     head_fn: Callable   # (stage_params, x) -> logits [mb,S,V] fp32
     restack: Callable   # canonical decoder params -> stage-stacked tree
     unstack: Callable   # stage-stacked tree -> canonical decoder params
+    # stage_fn returns (y, aux_scalar): per-stage router losses (MoE) join
+    # the objective at each stage's backward tick
+    stage_has_aux: bool = False
 
 
 def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
@@ -73,11 +76,15 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
     Raises loudly for anything the pipeline path cannot honor — a silently
     replicated stage axis is the failure mode this replaces (VERDICT r3
     item 2)."""
-    if not isinstance(model, Decoder):
+    from maggy_tpu.models.moe import MoEDecoder, _ScannedMoELayer
+
+    is_moe = isinstance(model, MoEDecoder)
+    if not isinstance(model, Decoder) and not is_moe:
         raise ValueError(
-            "Pipeline parallelism (pp>1) currently supports the Decoder "
-            f"family only, got {type(model).__name__}. Drop pp from the "
-            "ShardingSpec or use parallel.pipeline primitives directly."
+            "Pipeline parallelism (pp>1) currently supports the Decoder/"
+            f"MoEDecoder families only, got {type(model).__name__}. Drop pp "
+            "from the ShardingSpec or use parallel.pipeline primitives "
+            "directly."
         )
     cfg = model.cfg
     if not cfg.scan_layers:
@@ -111,14 +118,16 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         partition_params=False,
     )
 
-    layer_cls = _ScannedLayer
+    layer_cls = _ScannedMoELayer if is_moe else _ScannedLayer
     if cfg.remat:
         layer_cls = nn.remat(
             layer_cls, prevent_cse=False, policy=REMAT_POLICIES[cfg.remat_policy]
         )
     chunk = nn.scan(
         layer_cls,
-        variable_axes={"params": 0},
+        variable_axes=(
+            {"params": 0, "intermediates": 0} if is_moe else {"params": 0}
+        ),
         split_rngs={"params": True},
         in_axes=nn.broadcast,
         length=l_per,
@@ -135,7 +144,7 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         tokens = raw[..., 0] if raw.ndim == 3 else raw
         return jnp.asarray(params["embedding"], cfg.dtype)[tokens]
 
-    def stage_fn(params, x, raw):
+    def _side_inputs(x, raw):
         if raw.ndim == 3:
             positions = raw[..., 1]
             segment_ids = raw[..., 2] if raw.shape[-1] >= 3 else None
@@ -144,10 +153,26 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
                 jnp.arange(x.shape[1], dtype=jnp.int32), x.shape[:2]
             )
             segment_ids = None
-        y, _ = chunk.apply(
-            {"params": params["layers"]}, x, positions, segment_ids
-        )
-        return y
+        return positions, segment_ids
+
+    if is_moe:
+        def stage_fn(params, x, raw):
+            from maggy_tpu.train.trainer import collect_aux_losses
+
+            positions, segment_ids = _side_inputs(x, raw)
+            (y, _), mods = chunk.apply(
+                {"params": params["layers"]}, x, positions, segment_ids,
+                mutable=["intermediates"],
+            )
+            # this stage's router balancing losses (shared collection rule)
+            return y, collect_aux_losses(mods)
+    else:
+        def stage_fn(params, x, raw):
+            positions, segment_ids = _side_inputs(x, raw)
+            y, _ = chunk.apply(
+                {"params": params["layers"]}, x, positions, segment_ids
+            )
+            return y
 
     # the head reuses the SAME modules as Decoder (single source of truth):
     # final_norm RMSNorm and the lm_head DenseGeneral applied functionally on
@@ -207,6 +232,7 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         head_fn=head_fn,
         restack=restack,
         unstack=unstack,
+        stage_has_aux=is_moe,
     )
 
 

@@ -65,6 +65,20 @@ def classification_loss_fn(logits: jax.Array, batch: Dict[str, jax.Array]) -> ja
     return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
 
 
+def collect_aux_losses(mods) -> jax.Array:
+    """Sum every ``*aux_loss`` intermediate a model sowed (MoE router
+    balancing). THE one matching rule — the dense train step, the pipeline
+    stage adapter, and tests all collect through here, so models that sow
+    and trainers that collect cannot silently desync."""
+    aux = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        mods.get("intermediates", {})
+    )[0]:
+        if "aux_loss" in jax.tree_util.keystr(path):
+            aux = aux + jnp.sum(leaf).astype(jnp.float32)
+    return aux
+
+
 def _model_inputs(batch: Dict[str, jax.Array]) -> Tuple:
     if "tokens" in batch:
         args = [batch["tokens"]]
@@ -370,7 +384,7 @@ class Trainer:
             else:
                 raw = tokens
 
-            loss, grads = pipeline_grads_1f1b(
+            out = pipeline_grads_1f1b(
                 parts.stage_fn,
                 loss_pp,
                 state.params,
@@ -379,13 +393,19 @@ class Trainer:
                 mesh=self.mesh,
                 first_fn=parts.first_fn,
                 stage_takes_raw=True,
+                stage_has_aux=parts.stage_has_aux,
             )
+            if parts.stage_has_aux:
+                loss, grads, aux = out
+            else:
+                (loss, grads), aux = out, jnp.zeros((), jnp.float32)
             new_state = state.apply_gradients(grads=grads)
-            zero = jnp.zeros((), jnp.float32)
+            # same metric semantics as the dense path: loss = data only,
+            # aux_loss = router terms, total = optimized objective
             return new_state, {
                 "loss": loss,
-                "aux_loss": zero,
-                "total_loss": loss,
+                "aux_loss": aux,
+                "total_loss": loss + aux,
                 "grad_norm": optax.global_norm(grads),
                 "step": state.step,
             }
@@ -405,12 +425,7 @@ class Trainer:
                     {"params": params}, *_model_inputs(batch), mutable=["intermediates"]
                 )
                 loss = self.loss_fn(logits, batch)
-                aux = 0.0
-                for path, leaf in jax.tree_util.tree_flatten_with_path(
-                    mods.get("intermediates", {})
-                )[0]:
-                    if "aux_loss" in jax.tree_util.keystr(path):
-                        aux = aux + jnp.sum(leaf)
+                aux = collect_aux_losses(mods)
                 return loss + aux, (loss, aux)
 
             (total, (loss, aux)), grads = jax.value_and_grad(loss_of, has_aux=True)(

@@ -146,6 +146,36 @@ def test_param_masked_model_zeroes_subtree_and_grads():
         ParamMaskedModel(base, {"nonexistent"}).init(jax.random.key(0), x)
 
 
+def test_moe_without_gates_forward():
+    """MoEConfig inherits .without(); the MoEDecoder must actually honor the
+    gates (an inherited-but-ignored ablated set would silently no-op)."""
+    from maggy_tpu.models import MoEConfig, MoEDecoder
+
+    cfg = MoEConfig.tiny_moe(dtype=jnp.float32)
+    tokens = _tokens(cfg)
+    model = MoEDecoder(cfg)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    base = model.apply({"params": params}, tokens)
+    ablated = MoEDecoder(cfg.without("layers.1")).apply({"params": params}, tokens)
+    assert not np.allclose(np.asarray(base), np.asarray(ablated), atol=1e-5)
+    # gating all layers' moe+attn leaves only embed -> norm -> head
+    all_off = MoEDecoder(cfg.without(["attn", "mlp"])).apply(
+        {"params": params}, tokens
+    )
+    assert not np.allclose(np.asarray(ablated), np.asarray(all_off), atol=1e-5)
+    # the gate also silences the router aux loss of the ablated block
+    from maggy_tpu.train.trainer import collect_aux_losses
+
+    _, mods_abl = MoEDecoder(cfg.without("mlp")).apply(
+        {"params": params}, tokens, mutable=["intermediates"]
+    )
+    _, mods_full = MoEDecoder(cfg).apply(
+        {"params": params}, tokens, mutable=["intermediates"]
+    )
+    assert float(collect_aux_losses(mods_abl)) == 0.0
+    assert float(collect_aux_losses(mods_full)) > 0.0
+
+
 def test_auto_ablate_tiers():
     # tier 1: config with without()
     m = auto_ablate(Decoder(DecoderConfig.tiny()), frozenset({"mlp"}))
