@@ -23,6 +23,7 @@ do not tile evenly fall back to ``blockwise_attention`` (differentiable).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -407,28 +408,55 @@ def _flash_core(
     return core
 
 
+def _env_tile(name: str):
+    """Optional hardware-tuned backward tile override (set by the watchdog
+    playbook after a tools/tune_flash.py sweep on live silicon; see
+    tools/tpu_playbook.py). Invalid values are ignored, not fatal."""
+    val = os.environ.get(name, "")
+    try:
+        n = int(val)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
+def _pick_divisor(s: int, cap: int) -> int:
+    """Largest power-of-two-stepped divisor of ``s`` that is ≤ cap (floor 8;
+    the floor can be a non-divisor for odd/tiny s, which the alignment check
+    in _flash_attention_jit then routes to blockwise)."""
+    b = min(cap, s)
+    while s % b:
+        b //= 2
+    return max(b, 8)
+
+
+def _snap_tile(tile, s: int):
+    """Snap an env-sourced tile to the largest sublane-aligned (multiple of
+    8) real divisor of the call's sequence ≤ tile, so a size tuned at one
+    geometry cannot silently demote a differently-shaped call to the
+    blockwise fallback (an explicit function argument, by contrast, is
+    honored verbatim). Returns None — meaning 'use the auto default' — when
+    no aligned divisor exists."""
+    if not tile:
+        return None
+    b = min(tile, s)
+    b -= b % 8
+    while b >= 8:
+        if s % b == 0:
+            return b
+        b -= 8
+    return None
+
+
 def _auto_blocks(sq: int, sk: int) -> tuple:
     """Largest MXU-friendly tile sizes that divide the sequence. Measured in
     the full train step on v5e (BENCH_NOTES round 2): 512-row q tiles are
     ~2.7x faster than the FlashAttention-conventional 128 (66.9k vs 24.6k
     tok/s at S=1024 — small tiles leave the MXU idle between grid steps);
     k tiles of 512, widening to 1024 at long S, were best of the sweep."""
-
-    def pick(s: int, cap: int) -> int:
-        b = min(cap, s)
-        while s % b:
-            b //= 2
-        return max(b, 8)
-
-    return pick(sq, 512), pick(sk, 1024 if sk >= 4096 else 512)
+    return _pick_divisor(sq, 512), _pick_divisor(sk, 1024 if sk >= 4096 else 512)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "causal", "block_q", "block_k", "bwd_block_q", "bwd_block_k", "interpret",
-    ),
-)
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -447,8 +475,41 @@ def flash_attention(
     sequence length (``_auto_blocks``); ``bwd_block_q``/``bwd_block_k``
     default to the forward's and can be tuned independently (the backward
     kernels carry 6+ operand tiles, so their VMEM sweet spot differs —
-    tools/tune_flash.py). ``segment_ids`` [B, S] masks attention across
-    packed-sequence boundaries in-kernel."""
+    tools/tune_flash.py; MAGGY_TPU_FLASH_BWD_Q/_K carry a measured winner
+    into processes that never pass tiles explicitly, resolved here OUTSIDE
+    the jit cache so an env change cannot hit a stale compilation).
+    ``segment_ids`` [B, S] masks attention across packed-sequence
+    boundaries in-kernel."""
+    if bwd_block_q is None:
+        bwd_block_q = _snap_tile(_env_tile("MAGGY_TPU_FLASH_BWD_Q"), q.shape[1])
+    if bwd_block_k is None:
+        bwd_block_k = _snap_tile(_env_tile("MAGGY_TPU_FLASH_BWD_K"), k.shape[1])
+    return _flash_attention_jit(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        bwd_block_q=bwd_block_q, bwd_block_k=bwd_block_k,
+        interpret=interpret, segment_ids=segment_ids,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "block_q", "block_k", "bwd_block_q", "bwd_block_k", "interpret",
+    ),
+)
+def _flash_attention_jit(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    segment_ids=None,
+) -> jax.Array:
     b, sq, h, d = q.shape
     kh = k.shape[2]
     sk = k.shape[1]

@@ -1,0 +1,56 @@
+"""Capture a profiler trace of the bench-geometry train step (VERDICT r4
+item 1: "profile one train step"). Writes a TensorBoard-readable trace to
+tools/profile_r5/ for MFU-gap analysis on live silicon.
+
+    python tools/profile_step.py [--steps 5]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+from maggy_tpu.util import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=5)
+    parser.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "profile_r5"),
+    )
+    args = parser.parse_args()
+
+    from bench import apply_tuned_config, bench_setup, ensure_live_backend
+
+    cpu = ensure_live_backend()
+    apply_tuned_config()
+
+    import jax
+
+    # shared with bench.py — the trace is only useful if it profiles exactly
+    # the step (model, sharding, optimizer, batch, warmup) the record was
+    # set on; compile happens inside bench_setup, outside the trace
+    trainer, state, batch, cfg, batch_size, seq_len = bench_setup(cpu)
+
+    os.makedirs(args.out, exist_ok=True)
+    with jax.profiler.trace(args.out):
+        for _ in range(args.steps):
+            state, m = trainer.step(state, batch)
+        float(m["loss"])
+    print(f"trace written to {args.out} ({args.steps} steps, cpu={cpu})")
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, m = trainer.step(state, batch)
+    float(m["loss"])
+    print(f"untraced step: {(time.perf_counter() - t0) / args.steps * 1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

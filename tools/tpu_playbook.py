@@ -1,0 +1,220 @@
+"""Full measurement playbook for tunnel recovery (VERDICT r4 item 1).
+
+Run by tools/tpu_watchdog.sh the moment the TPU tunnel answers a liveness
+probe. Executes the whole staged-perf validation sequence as child
+processes — sequentially, with NO timeout kills (killing a client
+mid-compile wedges the tunnel for everyone) — and persists every artifact
+under tools/ so a later round can read the numbers even if this process's
+session is over:
+
+  1. bench.py                      -> tools/bench_early_r5.json (+ snapshot)
+  2. tune_flash.py --emit          -> tools/flash_tuned_r5.json (bwd tiles)
+  3. batch-size sweep {16, 32} with the tuned tiles
+                                   -> tools/bench_bs{N}_r5.json
+     winner                        -> tools/tuned_bench.json  (bench.py
+                                      auto-applies this at round-end)
+  4. bench_decode.py               -> tools/bench_decode_r5.json
+  5. examples/resnet_asha.py       -> tools/resnet_asha_r5.log
+  6. profile_step.py               -> tools/profile_r5/ (trace for analysis)
+
+    python tools/tpu_playbook.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+TOOLS = os.path.join(ROOT, "tools")
+LOG = os.path.join(TOOLS, "tpu_playbook.log")
+
+
+def note(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def run(cmd, out_path=None, env_extra=None):
+    """Run a child to completion (never killed — tunnel safety). The full
+    combined stream goes to <out_path>.log; when out_path ends in .json only
+    the last parseable JSON line is written there, so artifact files stay
+    json.load-able even when warnings precede the result line. Returns
+    (rc, last_json_or_None)."""
+    env = dict(os.environ)
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    if env_extra:
+        env.update({k: str(v) for k, v in env_extra.items()})
+    note(f"run: {' '.join(cmd)} env+={env_extra or {}}")
+    proc = subprocess.run(
+        cmd, cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    parsed = None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                break
+            except ValueError:
+                continue
+
+    def is_real(d):
+        if d is None:
+            return False
+        extra = d.get("extra", d)
+        return not extra.get("cpu_fallback", False)
+
+    if out_path:
+        if out_path.endswith(".json"):
+            base = os.path.splitext(out_path)[0]
+            # a watchdog retry that flakes back to CPU must not clobber a
+            # prior attempt's real-silicon artifact — reroute junk aside
+            try:
+                with open(out_path) as f:
+                    prior_real = is_real(json.load(f))
+            except (OSError, ValueError):
+                prior_real = False
+            if parsed is None:
+                with open(base + ".failed.log", "w") as f:
+                    f.write(proc.stdout)
+            elif prior_real and not is_real(parsed):
+                note(f"  keeping prior real artifact {out_path}; new run was CPU junk")
+                with open(base + ".rejected.log", "w") as f:
+                    f.write(proc.stdout)
+            else:
+                with open(base + ".log", "w") as f:
+                    f.write(proc.stdout)
+                with open(out_path, "w") as f:
+                    json.dump(parsed, f)
+        else:
+            with open(out_path, "w") as f:
+                f.write(proc.stdout)
+    note(f"  rc={proc.returncode} json={'yes' if parsed else 'no'}")
+    return proc.returncode, parsed
+
+
+def main() -> int:
+    py = sys.executable
+    note("playbook start")
+
+    # Measure from a clean slate: a prior attempt's tuning must not leak into
+    # this run's baselines or be mistaken for a fresh measurement. Restored
+    # on abort — and, for a prior attempt that crashed between move and
+    # rewrite, at startup — so a failed attempt never loses measured tuning.
+    moved = []
+    for stale in ("tuned_bench.json", "flash_tuned_r5.json"):
+        path = os.path.join(TOOLS, stale)
+        if os.path.exists(path + ".prev") and not os.path.exists(path):
+            os.replace(path + ".prev", path)
+            note(f"recovered {stale} stranded as .prev by a crashed attempt")
+        if os.path.exists(path):
+            os.replace(path, path + ".prev")
+            moved.append(path)
+            note(f"moved stale {stale} -> {stale}.prev")
+
+    def restore_prev():
+        for path in moved:
+            if not os.path.exists(path):
+                os.replace(path + ".prev", path)
+                note(f"restored {os.path.basename(path)} from .prev")
+
+    # 1. baseline bench: default-bs untiled, full metrics (snapshots if real)
+    rc, early = run([py, "bench.py"], os.path.join(TOOLS, "bench_early_r5.json"))
+    if rc != 0 or early is None or early.get("extra", {}).get("cpu_fallback"):
+        note("bench failed or fell back to CPU; aborting silicon sweep")
+        restore_prev()
+        return 1
+
+    # 2. flash backward-tile sweep on silicon
+    flash_json = os.path.join(TOOLS, "flash_tuned_r5.json")
+    run(
+        [py, "tools/tune_flash.py", "--seq", "1024", "--steps", "10",
+         "--emit", flash_json],
+        os.path.join(TOOLS, "tune_flash_r5.log"),
+    )
+    tiles = {}
+    try:
+        with open(flash_json) as f:
+            win = json.load(f)
+        sys.path.insert(0, ROOT)
+        from maggy_tpu.ops.flash import _auto_blocks
+
+        # bwd tiles default to the fwd tiles, which at the bench geometry
+        # come from _auto_blocks — a "winner" equal to that default changes
+        # nothing, so don't burn tunnel minutes re-benching it
+        default_q, default_k = _auto_blocks(1024, 1024)
+        if (win["bwd_block_q"], win["bwd_block_k"]) == (default_q, default_k):
+            note(f"flash bwd winner {win} == auto default; skipping tiled runs")
+        else:
+            tiles = {
+                "MAGGY_TPU_FLASH_BWD_Q": win["bwd_block_q"],
+                "MAGGY_TPU_FLASH_BWD_K": win["bwd_block_k"],
+            }
+            note(f"flash bwd winner: {win}")
+    except (OSError, ValueError, KeyError):
+        note("no flash winner emitted (cpu or sweep failure); tiles unset")
+
+    # 3. config sweep (--train-only skips the ASHA/ring secondary benches —
+    # tunnel-alive minutes are the scarce resource). The untiled step-1
+    # baseline competes too, so microbench tile "wins" that regress the full
+    # train step are rejected rather than persisted.
+    base_bs = early.get("extra", {}).get("batch_size_per_chip", 16)
+    candidates = [(bs, {}) for bs in (16, 32) if bs != base_bs]
+    if tiles:
+        candidates += [(16, tiles), (32, tiles)]
+    best = (base_bs, {}, early["value"])  # step-1 baseline, as actually run
+    note(f"baseline bs={base_bs} untiled: {early['value']} tok/s/chip")
+    for bs, t in candidates:
+        _, res = run(
+            [py, "bench.py", "--train-only"],
+            os.path.join(TOOLS, f"bench_bs{bs}{'_tiled' if t else ''}_r5.json"),
+            env_extra={"MAGGY_TPU_BENCH_BS": bs, **t},
+        )
+        if not res or res.get("extra", {}).get("cpu_fallback"):
+            continue
+        note(f"bs={bs} tiles={bool(t)}: {res['value']} tok/s/chip")
+        if res["value"] > best[2]:
+            best = (bs, t, res["value"])
+    tuned = {"batch_size": best[0], "value": best[2]}
+    if best[1]:
+        tuned["bwd_block_q"] = best[1]["MAGGY_TPU_FLASH_BWD_Q"]
+        tuned["bwd_block_k"] = best[1]["MAGGY_TPU_FLASH_BWD_K"]
+    with open(os.path.join(TOOLS, "tuned_bench.json"), "w") as f:
+        json.dump(tuned, f)
+    note(f"tuned_bench.json written: {tuned}")
+
+    # 3b. full bench at the winning config — lands the snapshot record with
+    # ASHA + ring secondary metrics included (train-only runs never snapshot)
+    if best[:2] != (base_bs, {}):
+        run([py, "bench.py"], os.path.join(TOOLS, "bench_tuned_r5.json"))
+
+    # 4. decode throughput table
+    run([py, "tools/bench_decode.py"],
+        os.path.join(TOOLS, "bench_decode_r5.json"))
+
+    # 5. real-train_fn ASHA (BASELINE config 2 in miniature) on silicon
+    run([py, "examples/resnet_asha.py"],
+        os.path.join(TOOLS, "resnet_asha_r5.log"))
+
+    # 6. profiler trace of the bench train step for later analysis
+    run([py, "tools/profile_step.py"],
+        os.path.join(TOOLS, "profile_step_r5.log"))
+
+    note("playbook done")
+    # if the tunnel died mid-playbook the artifacts above are CPU junk; tell
+    # the watchdog to keep probing for a genuine recovery
+    sys.path.insert(0, ROOT)
+    from maggy_tpu.util import backend_alive
+
+    alive = backend_alive(150)
+    note(f"final liveness: {'alive' if alive else 'dead'}")
+    return 0 if alive else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,24 +1,29 @@
 #!/bin/bash
-# TPU tunnel watchdog: probe liveness every ~7 min; on first success run
-# bench.py (never timeout-killed — killing a client mid-compile wedges the
-# tunnel) so BENCH_TPU_SNAPSHOT.json captures a real-hardware record early.
-# Writes status lines to tools/tpu_watchdog.log (gitignored).
+# TPU tunnel watchdog (round 5): probe liveness every ~7 min; on first
+# success run the FULL measurement playbook (tools/tpu_playbook.py: bench +
+# flash bwd-tile sweep + bs sweep + decode + real-train ASHA + profile
+# trace). Children are never timeout-killed — killing a client mid-compile
+# wedges the tunnel. One watchdog only; writes tools/tpu_watchdog.log
+# (gitignored).
 cd /root/repo
 LOG=tools/tpu_watchdog.log
-echo "$(date -u +%FT%TZ) watchdog start" >> "$LOG"
+echo "$(date -u +%FT%TZ) r5 watchdog start" >> "$LOG"
 for i in $(seq 1 200); do
   if python -c "
 from maggy_tpu.util import backend_alive
 import sys
 sys.exit(0 if backend_alive(150) else 1)
 "; then
-    echo "$(date -u +%FT%TZ) tunnel ALIVE (probe $i); running bench" >> "$LOG"
-    python bench.py > tools/bench_early_r4.json 2> tools/bench_early_r4.err
-    echo "$(date -u +%FT%TZ) bench rc=$? done; running decode bench" >> "$LOG"
-    python tools/bench_decode.py > tools/bench_decode_r4.json 2> tools/bench_decode_r4.err
-    echo "$(date -u +%FT%TZ) decode bench rc=$? done" >> "$LOG"
-    exit 0
+    echo "$(date -u +%FT%TZ) tunnel ALIVE (probe $i); running playbook" >> "$LOG"
+    python tools/tpu_playbook.py >> tools/tpu_playbook.stdout 2>&1
+    rc=$?
+    echo "$(date -u +%FT%TZ) playbook rc=$rc done" >> "$LOG"
+    # rc!=0 = the backend fell back / died mid-playbook (false-positive
+    # probe); keep probing so a later genuine recovery still gets benched
+    [ "$rc" -eq 0 ] && exit 0
+    echo "$(date -u +%FT%TZ) playbook failed on live probe $i; will re-probe" >> "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) r5 probe $i dead; sleeping 420s" >> "$LOG"
   fi
-  echo "$(date -u +%FT%TZ) probe $i dead; sleeping 420s" >> "$LOG"
   sleep 420
 done

@@ -28,6 +28,11 @@ def main():
     parser.add_argument("--seq", type=int, default=1024)
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument(
+        "--emit", default=None, metavar="PATH",
+        help="write the winning bwd tiles as JSON (consumed by bench.py via "
+             "MAGGY_TPU_FLASH_BWD_Q/_K; see tools/tpu_playbook.py)",
+    )
     args = parser.parse_args()
 
     from bench import ensure_live_backend
@@ -83,6 +88,16 @@ def main():
         "ranking": rows[:5],
         "device": str(jax.devices()[0]),
     }))
+    # never emit toy-geometry (cpu/--quick) tiles as flagship winners
+    if args.emit and rows and not cpu and not args.quick:
+        with open(args.emit, "w") as f:
+            json.dump({
+                "bwd_block_q": rows[0]["bwd_block_q"],
+                "bwd_block_k": rows[0]["bwd_block_k"],
+                "ms": rows[0]["ms"],
+                "geometry": f"B={B} S={S} H={H} D={D}",
+                "device": str(jax.devices()[0]),
+            }, f)
 
 
 if __name__ == "__main__":
