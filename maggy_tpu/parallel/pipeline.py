@@ -38,6 +38,28 @@ from jax.sharding import PartitionSpec as P
 from maggy_tpu.parallel.spec import AXIS_DATA, AXIS_FSDP, AXIS_STAGE
 
 
+def _manual_axes(mesh, axis_name) -> frozenset:
+    """The pipeline shard_maps are manual over stage (ppermute hand-offs) and
+    data/fsdp (explicit grad/loss psums) ONLY; every other mesh axis —
+    `tensor` being the live case (pp x tp) — stays in GSPMD-auto mode, so a
+    stage body whose params carry tensor-sharded dims (attn heads / mlp
+    hidden / vocab) is tensor-parallelized by XLA inside each stage.
+
+    When every would-be-auto axis is trivial (extent 1) this returns ALL
+    mesh axes (full-manual): jax 0.9's partial-manual mode rejects EAGER
+    calls on any mesh that has non-manual axes, and full-manual is
+    semantically identical there — so eager pipeline_apply keeps working on
+    plain pp x dp meshes, and the partial-manual path (always reached
+    through the Trainer's jit) engages only when tp/sp/ep is real."""
+    manual = frozenset({axis_name, AXIS_DATA, AXIS_FSDP}) & frozenset(
+        mesh.axis_names
+    )
+    shape = dict(mesh.shape)
+    if all(shape[a] == 1 for a in mesh.axis_names if a not in manual):
+        return frozenset(mesh.axis_names)
+    return manual
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params,
@@ -130,6 +152,7 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(P(axis_name), batch_spec),
         out_specs=out_spec,
+        axis_names=_manual_axes(mesh, axis_name),
         check_vma=False,
     )(stage_params, microbatches)
 
@@ -377,6 +400,7 @@ def pipeline_grads_1f1b(
         mesh=mesh,
         in_specs=(P(axis_name), batch_spec, batch_spec),
         out_specs=(P(), P(axis_name), P()),
+        axis_names=_manual_axes(mesh, axis_name),
         check_vma=False,
     )(stage_params, microbatches, targets)
     if stage_has_aux:

@@ -68,9 +68,18 @@ class DecoderPipelineParts:
     # stage_fn returns (y, aux_scalar): per-stage router losses (MoE) join
     # the objective at each stage's backward tick
     stage_has_aux: bool = False
+    # logical-axis names per stage-tree leaf ((None, ...canonical names) —
+    # leading dim is the stage axis). The Trainer resolves these against its
+    # rules to place tensor-parallel dims (attn heads / mlp hidden / vocab)
+    # over the mesh's `tensor` axis inside each stage (pp x tp; the pipeline
+    # shard_map stays manual over stage/data/fsdp and leaves `tensor` to
+    # GSPMD). None for non-Decoder flows that build parts by hand.
+    stage_names: Any = None
 
 
-def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
+def decoder_pipeline_parts(
+    model: Any, n_stages: int, tp: int = 1
+) -> DecoderPipelineParts:
     """Build the 1F1B parts for a :class:`Decoder`.
 
     Raises loudly for anything the pipeline path cannot honor — a silently
@@ -108,10 +117,22 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
             "would silently untie. Use tie_embeddings=False under pp."
         )
     l_per = cfg.n_layers // n_stages
+    if tp > 1 and cfg.n_heads % tp:
+        raise ValueError(
+            f"n_heads={cfg.n_heads} not divisible by tp={tp}: the stage-local "
+            "attention shards the head axis over the tensor mesh axis"
+        )
+    # under pp x tp the stage body runs with the tensor axis in GSPMD-auto
+    # mode; the Pallas flash kernel is an opaque custom call XLA cannot
+    # partition over the sharded head axis, so route to the XLA einsum
+    # attention, which GSPMD tensor-parallelizes like any other matmul
+    local_attn = (
+        default_attention if tp > 1 else _pp_local_attention
+    )
     stage_cfg = dataclasses.replace(
         cfg,
         n_layers=l_per,
-        attention_fn=cfg.attention_fn or _pp_local_attention,
+        attention_fn=cfg.attention_fn or local_attn,
         # no logical-axis boxes inside the shard_map: placement is manual
         # (P('stage') on the stacked tree), and flax would otherwise try to
         # resolve names like 'embed' against the physical mesh mid-region
@@ -224,6 +245,31 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         }
         return out
 
+    # logical axes per stage leaf, for pp x tp placement: the canonical
+    # model's own nn.Partitioned names (same source params_shardings reads on
+    # the dense path), pushed through restack's layout — every stage leaf
+    # gains a leading stage axis, so names gain a leading None. Only built
+    # when a tensor axis is real: at tp=1 the resolution could only ever
+    # return the plain P('stage') placement, so skip the extra abstract init
+    stage_names = None
+    if tp > 1:
+        pmodel = type(model)(dataclasses.replace(cfg, partition_params=True))
+        abstract = jax.eval_shape(
+            pmodel.init, jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )
+        canonical_names = jax.tree.map(
+            lambda l: tuple(l.names)
+            if isinstance(l, nn.Partitioned)
+            else (None,) * getattr(l, "ndim", 0),
+            abstract["params"],
+            is_leaf=lambda x: isinstance(x, nn.Partitioned),
+        )
+        stage_names = jax.tree.map(
+            lambda n: (None,) + n,
+            canonical_names,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+
     return DecoderPipelineParts(
         n_stages=n_stages,
         layers_per_stage=l_per,
@@ -233,6 +279,7 @@ def decoder_pipeline_parts(model: Any, n_stages: int) -> DecoderPipelineParts:
         restack=restack,
         unstack=unstack,
         stage_has_aux=is_moe,
+        stage_names=stage_names,
     )
 
 

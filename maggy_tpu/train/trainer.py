@@ -129,14 +129,17 @@ class Trainer:
             from maggy_tpu.train.pipeline_adapter import decoder_pipeline_parts
 
             shape = dict(self.mesh.shape)
-            bad = [a for a in (AXIS_TENSOR, AXIS_SEQ, AXIS_EXPERT) if shape.get(a, 1) > 1]
+            bad = [a for a in (AXIS_SEQ, AXIS_EXPERT) if shape.get(a, 1) > 1]
             if bad:
                 raise ValueError(
-                    f"pp>1 composes with dp/fsdp only; mesh also has {bad} > 1. "
-                    "Stage params are placed P('stage') — a tensor/seq/expert "
-                    "axis would silently replicate (VERDICT r3 item 2)."
+                    f"pp>1 composes with dp/fsdp/tp only; mesh also has {bad} "
+                    "> 1. Stage params are placed P('stage', ...) — a "
+                    "seq/expert axis would silently replicate (VERDICT r3 "
+                    "item 2)."
                 )
-            self._pp_parts = decoder_pipeline_parts(self.model, self.pp)
+            self._pp_parts = decoder_pipeline_parts(
+                self.model, self.pp, tp=shape.get(AXIS_TENSOR, 1)
+            )
         return self._pp_parts
 
     # ------------------------------------------------------------------ state
@@ -175,7 +178,29 @@ class Trainer:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
-            n_stages = self._pipeline_parts().n_stages
+            parts = self._pipeline_parts()
+            n_stages = parts.n_stages
+            tp_ext = dict(self.mesh.shape).get(AXIS_TENSOR, 1)
+
+            def tensor_dims(names, shape):
+                """Mesh axes for a stage leaf's trailing dims: ONLY the
+                tensor axis is applied (pp x tp) — the pipeline shard_map is
+                manual over stage/data/fsdp with params replicated there, so
+                an fsdp/seq rule resolution would contradict its in_specs
+                and reshard every step."""
+                table = dict(self.rules)
+                out = []
+                for name, dim in zip(names, shape):
+                    ax = table.get(name) if name else None
+                    keep = ax == AXIS_TENSOR or (
+                        isinstance(ax, (tuple, list)) and tuple(ax) == (AXIS_TENSOR,)
+                    )
+                    out.append(
+                        AXIS_TENSOR
+                        if keep and tp_ext > 1 and dim % tp_ext == 0
+                        else None
+                    )
+                return out
 
             def shard_of(leaf):
                 # every stage-stacked leaf (params and the optax state
@@ -185,7 +210,36 @@ class Trainer:
                     return NamedSharding(self.mesh, P(AXIS_STAGE))
                 return NamedSharding(self.mesh, P())
 
-            self.state_shardings = jax.tree.map(shard_of, abstract)
+            if parts.stage_names is not None:
+                spec_params = jax.tree.map(
+                    lambda names, leaf: NamedSharding(
+                        self.mesh,
+                        P(AXIS_STAGE, *tensor_dims(names[1:], leaf.shape[1:])),
+                    ),
+                    parts.stage_names,
+                    abstract.params,
+                    is_leaf=lambda x: isinstance(x, tuple),
+                )
+                pstruct = jax.tree_util.tree_structure(abstract.params)
+
+                def is_ptree(x):
+                    try:
+                        return jax.tree_util.tree_structure(x) == pstruct
+                    except Exception:
+                        return False
+
+                # params and every optax mirror of them (adam mu/nu, ...)
+                # get the tensor-resolved specs; loose leaves (step, adam
+                # count) fall back to the stage/replicated rule
+                self.state_shardings = jax.tree.map(
+                    lambda x: spec_params
+                    if is_ptree(x)
+                    else jax.tree.map(shard_of, x),
+                    abstract,
+                    is_leaf=is_ptree,
+                )
+            else:
+                self.state_shardings = jax.tree.map(shard_of, abstract)
         else:
             self.state_shardings = shd.params_shardings(
                 self.mesh, abstract, self.rules

@@ -276,10 +276,11 @@ def test_pp_raises_loudly_for_unsupported():
     with pytest.raises(ValueError, match="Decoder"):
         trainer.make_state(jax.random.key(0), {"inputs": np.zeros((8, 4), np.float32)})
 
-    # pp x tp would silently replicate stage params over tensor: refuse
-    ctx2 = TrainContext.create(ShardingSpec(pp=2, dp=2, tp=2))
+    # pp x sp would silently replicate stage params over seq: refuse
+    # (pp x tp is supported — see test_pp_tp_* below)
+    ctx2 = TrainContext.create(ShardingSpec(pp=2, dp=2, sp=2))
     tr2 = ctx2.trainer(Decoder(cfg), optax.sgd(1e-2))
-    with pytest.raises(ValueError, match="dp/fsdp"):
+    with pytest.raises(ValueError, match="dp/fsdp/tp"):
         tr2.make_state(jax.random.key(0), batch)
 
     # layer count must split evenly into stages
@@ -302,3 +303,95 @@ def test_pp_raises_loudly_for_unsupported():
     state5 = tr5.make_state(jax.random.key(0), batch)  # bsz=8 -> mb=2 < dpf=4
     with pytest.raises(ValueError, match="microbatches"):
         tr5.step(state5, tr5.shard_batch(batch))
+
+
+def test_pp_tp_matches_dense_loss_and_grads():
+    """pp=2 x tp=2 x dp=2 (VERDICT r4 item 2): stage params carry
+    tensor-sharded dims (attn heads / mlp hidden / vocab — the model's own
+    logical axes resolved through the Trainer rules), the pipeline shard_map
+    stays manual over stage/data/fsdp with `tensor` in GSPMD-auto mode, and
+    the 1F1B step matches dense jax.grad on the same params."""
+    cfg = DecoderConfig.tiny()
+    batch = _batch(cfg)
+
+    ctx = TrainContext.create(ShardingSpec(pp=2, tp=2, dp=2))
+    trainer = ctx.trainer(Decoder(cfg), optax.sgd(1e-2), n_microbatches=2)
+    state = trainer.make_state(jax.random.key(0), batch)
+
+    # placement: heads/mlp/vocab dims really sit on the tensor axis
+    specs = {
+        jax.tree_util.keystr(p): leaf.sharding.spec
+        for p, leaf in jax.tree_util.tree_leaves_with_path(state.params)
+    }
+    assert specs["['embedding']"] == jax.sharding.PartitionSpec(
+        "stage", "tensor", None
+    )
+    assert "tensor" in specs["['layers']['layer']['attn']['wq']['kernel']"]
+    assert "tensor" in specs["['layers']['layer']['mlp']['w_gate']['kernel']"]
+    assert "tensor" in specs["['lm_head']['kernel']"]
+
+    parts = trainer._pipeline_parts()
+    dense_params = jax.device_get(jax.jit(parts.unstack)(state.params))
+    model = Decoder(cfg)
+
+    def dense_loss(params):
+        return lm_loss_fn(model.apply({"params": params}, batch["tokens"]), batch)
+
+    ref_loss, ref_grads = jax.value_and_grad(dense_loss)(dense_params)
+
+    new_state, metrics = trainer.step(state, trainer.shard_batch(batch))
+    assert abs(float(metrics["loss"]) - float(ref_loss)) < 2e-3
+
+    got = jax.device_get(jax.jit(parts.unstack)(new_state.params))
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_grads)
+    flat_old = dict(jax.tree_util.tree_leaves_with_path(dense_params))
+    flat_new = dict(jax.tree_util.tree_leaves_with_path(got))
+    for path, g_ref in flat_ref:
+        g_got = (flat_old[path] - flat_new[path]) / 1e-2
+        np.testing.assert_allclose(
+            np.asarray(g_got), np.asarray(g_ref), atol=5e-2,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pp_tp_trains_and_eval_matches():
+    """pp x tp under adamw decreases the loss; eval_logits through the
+    unstacked model matches a host-side dense apply (bf16 reduction-order
+    tolerance: tensor-partitioned einsums reduce in a different order)."""
+    cfg = DecoderConfig.tiny()
+    batch = _batch(cfg)
+    ctx = TrainContext.create(ShardingSpec(pp=2, tp=2, dp=2))
+    trainer = ctx.trainer(Decoder(cfg), optax.adamw(1e-2), n_microbatches=2)
+    state = trainer.make_state(jax.random.key(0), batch)
+    losses = []
+    for _ in range(4):
+        state, m = trainer.step(state, trainer.shard_batch(batch))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+    parts = trainer._pipeline_parts()
+    dense_params = jax.device_get(jax.jit(parts.unstack)(state.params))
+    ref = Decoder(cfg).apply({"params": dense_params}, jnp.asarray(batch["tokens"]))
+    got = trainer.eval_logits(state, trainer.shard_batch(batch))
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(got)), np.asarray(jax.device_get(ref)), atol=3e-2
+    )
+
+
+def test_pp_tp_moe_trains():
+    """MoEDecoder under pp x tp: expert FFN hidden dims tensor-shard inside
+    each stage; router aux still joins per stage."""
+    from maggy_tpu.models import MoEConfig, MoEDecoder
+
+    cfg = MoEConfig.tiny_moe()
+    batch = _batch(cfg, bsz=8, seq=16)
+    ctx = TrainContext.create(ShardingSpec(pp=2, tp=2, dp=2))
+    trainer = ctx.trainer(MoEDecoder(cfg), optax.adamw(1e-2), n_microbatches=2)
+    state = trainer.make_state(jax.random.key(1), batch)
+    losses = []
+    for _ in range(3):
+        state, m = trainer.step(state, trainer.shard_batch(batch))
+        losses.append(float(m["total_loss"]))
+    assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0]
+    assert float(m["aux_loss"]) > 0
