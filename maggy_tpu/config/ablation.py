@@ -24,6 +24,8 @@ class AblationConfig(LagomConfig):
         optimization_key: str = "metric",
         log_dir: Optional[str] = None,
         sharding: Optional[Any] = None,
+        driver_addr: Optional[str] = None,
+        worker_timeout: float = 600.0,
     ):
         super().__init__(name, description, hb_interval)
         if direction not in ("max", "min"):
@@ -38,3 +40,5 @@ class AblationConfig(LagomConfig):
         self.optimization_key = optimization_key
         self.log_dir = log_dir
         self.sharding = sharding
+        self.driver_addr = driver_addr
+        self.worker_timeout = float(worker_timeout)

@@ -40,6 +40,8 @@ class HyperparameterOptConfig(LagomConfig):
         log_dir: Optional[str] = None,
         resume_from: Optional[str] = None,
         sharding: Optional[Any] = None,
+        driver_addr: Optional[str] = None,
+        worker_timeout: float = 600.0,
     ):
         """:param num_trials: total trials to run (pruner may override, as in the
             reference optimization_driver.py:88-93).
@@ -61,6 +63,16 @@ class HyperparameterOptConfig(LagomConfig):
         :param sharding: TrainContext preset ("dp", "fsdp", ...) or ShardingSpec
             for the ``ctx`` injected into train_fns that ask for it; defaults
             to "dp" over the trial's leased devices.
+        :param driver_addr: pod mode — remote trial workers connect here
+            (``host:port``; usually left to the MAGGY_TPU_DRIVER env var the
+            launcher exports). The reference gets cross-host trial executors
+            from Spark (spark_driver.py:136-145); here any host running the
+            same script with MAGGY_TPU_ROLE=worker adds trial capacity.
+        :param worker_timeout: pod mode — seconds of silence after which a
+            registered remote worker is presumed dead: its in-flight trial is
+            marked ERROR and freed, and the experiment CONTINUES on the
+            remaining capacity (a respawned worker re-registers and serves
+            again — ``python -m maggy_tpu.run --respawn``).
         """
         super().__init__(name, description, hb_interval)
         if not isinstance(num_trials, int) or num_trials <= 0:
@@ -89,3 +101,5 @@ class HyperparameterOptConfig(LagomConfig):
         self.log_dir = log_dir
         self.resume_from = resume_from
         self.sharding = sharding
+        self.driver_addr = driver_addr
+        self.worker_timeout = float(worker_timeout)

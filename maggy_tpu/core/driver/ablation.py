@@ -78,62 +78,16 @@ class AblationDriver(HyperparameterOptDriver):
             num_executors=config.num_executors,
             devices_per_trial=config.devices_per_trial,
             log_dir=config.log_dir,
+            sharding=config.sharding,
+            driver_addr=getattr(config, "driver_addr", None),
+            worker_timeout=getattr(config, "worker_timeout", 600.0),
         )
         super().__init__(hpo_config, app_id, run_id)
 
     # ------------------------------------------------------------------ executor
 
     def _resolver(self):
-        study = self.study
-        dataset_generator = study.dataset_generator or default_dataset_generator
-
-        def resolve(params, available):
-            feature = params.get("ablated_feature")
-            component = params.get("ablated_component")
-            feature = None if feature in (None, "None") else feature
-            component = None if component in (None, "None") else component
-
-            available = dict(available)
-            available["ablated_feature"] = feature
-            available["ablated_component"] = component
-            # the markers ride dedicated kwargs; hparams stays clean so train_fns
-            # that splat it into config constructors remain oblivious
-            available["hparams"] = {
-                k: v
-                for k, v in available["hparams"].items()
-                if k not in ("ablated_feature", "ablated_component")
-            }
-            available["dataset"] = dataset_generator(available["dataset"], feature)
-
-            if component is not None and component.startswith("custom:"):
-                name = component[len("custom:"):]
-                available["model"] = study.model.custom_generators[name]()
-            elif study.model.factory is not None:
-                ablated = (
-                    frozenset() if component is None else frozenset(component.split("|"))
-                )
-                available["model"] = study.model.factory(ablated)
-            elif component is not None:
-                # factory-free path (reference parity: any model, zero
-                # plumbing — loco.py:82-136): derive the variant from the
-                # config model via config.without()/ablated-field rebuild, or
-                # generic param-subtree masking
-                from maggy_tpu.ablation.masking import auto_ablate
-
-                base = available.get("model")
-                if base is None:
-                    raise ValueError(
-                        f"Trial ablates component {component!r} but the study "
-                        "has no model factory and the config has no model; "
-                        "pass AblationConfig(model=...) or call "
-                        "study.model.set_factory(fn)."
-                    )
-                available["model"] = auto_ablate(
-                    base, frozenset(component.split("|"))
-                )
-            return available
-
-        return resolve
+        return make_ablation_resolver(self.study)
 
     def _executor_fn(self, train_fn: Callable, partition_id: int, devices: list) -> Callable:
         return trial_executor_fn(
@@ -147,3 +101,58 @@ class AblationDriver(HyperparameterOptDriver):
             devices=devices,
             resolve=self._resolver(),
         )
+
+
+def make_ablation_resolver(study):
+    """Trial-params -> train_fn-kwargs resolver for ablation trials. Module
+    level so pod trial workers — which hold the same AblationConfig the
+    driver does — can rebuild it host-side (core/pod.py run_trial_worker)."""
+    dataset_generator = study.dataset_generator or default_dataset_generator
+
+    def resolve(params, available):
+        feature = params.get("ablated_feature")
+        component = params.get("ablated_component")
+        feature = None if feature in (None, "None") else feature
+        component = None if component in (None, "None") else component
+
+        available = dict(available)
+        available["ablated_feature"] = feature
+        available["ablated_component"] = component
+        # the markers ride dedicated kwargs; hparams stays clean so train_fns
+        # that splat it into config constructors remain oblivious
+        available["hparams"] = {
+            k: v
+            for k, v in available["hparams"].items()
+            if k not in ("ablated_feature", "ablated_component")
+        }
+        available["dataset"] = dataset_generator(available["dataset"], feature)
+
+        if component is not None and component.startswith("custom:"):
+            name = component[len("custom:"):]
+            available["model"] = study.model.custom_generators[name]()
+        elif study.model.factory is not None:
+            ablated = (
+                frozenset() if component is None else frozenset(component.split("|"))
+            )
+            available["model"] = study.model.factory(ablated)
+        elif component is not None:
+            # factory-free path (reference parity: any model, zero
+            # plumbing — loco.py:82-136): derive the variant from the
+            # config model via config.without()/ablated-field rebuild, or
+            # generic param-subtree masking
+            from maggy_tpu.ablation.masking import auto_ablate
+
+            base = available.get("model")
+            if base is None:
+                raise ValueError(
+                    f"Trial ablates component {component!r} but the study "
+                    "has no model factory and the config has no model; "
+                    "pass AblationConfig(model=...) or call "
+                    "study.model.set_factory(fn)."
+                )
+            available["model"] = auto_ablate(
+                base, frozenset(component.split("|"))
+            )
+        return available
+
+    return resolve

@@ -79,9 +79,23 @@ class HyperparameterOptDriver(Driver):
         self._optimizer_exhausted = False
         self._maybe_idle: set = set()
 
+        # pod mode (reference parity: Spark runs trial executors on cluster
+        # hosts, spark_driver.py:136-145): remote hosts running the same
+        # script with MAGGY_TPU_ROLE=worker connect as trial executors; the
+        # driver hosts partition 0 itself. Capacity is elastic — a silent
+        # worker's trial is freed after worker_timeout and the experiment
+        # continues on the remaining workers; a respawned worker re-registers
+        # (new attempt nonce) and serves again.
+        from maggy_tpu.core.pod import driver_address
+
+        self.pod_mode = bool(driver_address(config))
+        self._last_seen: Dict[int, float] = {}
+        self._gstop_sent: set = set()  # pids whose GET saw the experiment end
+
         groups = device_groups(config.devices_per_trial)
+        default_cap = 1 if self.pod_mode else len(groups)
         self.num_executors = max(
-            1, min(config.num_executors or len(groups), self.num_trials)
+            1, min(config.num_executors or default_cap, self.num_trials)
         )
 
     def _exp_startup_callback(self) -> None:
@@ -126,7 +140,11 @@ class HyperparameterOptDriver(Driver):
     # ------------------------------------------------------------------ server
 
     def _make_server(self) -> rpc.Server:
-        return rpc.Server(self.num_executors)
+        # pod launchers distribute one secret to every process via env; local
+        # runs mint a fresh one (Server does)
+        return rpc.Server(
+            self.num_executors, secret=os.environ.get("MAGGY_TPU_SECRET") or None
+        )
 
     def _register_msg_callbacks(self) -> None:
         s = self.server
@@ -136,10 +154,26 @@ class HyperparameterOptDriver(Driver):
         s.register_callback("METRIC", self._metric_callback)
         s.register_callback("FINAL", self._final_callback)
         s.register_callback("LOG", self._log_callback)
+        # pod trial workers bootstrap their app/run ids from the driver
+        # (core/pod.py run_trial_worker), same exchange the distributed
+        # driver serves
+        s.register_callback(
+            "EXEC_CONFIG",
+            lambda m: {
+                "type": "EXEC_CONFIG",
+                "app_id": self.app_id,
+                "run_id": self.run_id,
+            },
+        )
 
     # --- event-loop handlers: fast, lock briefly, enqueue heavy work ----------
 
+    def _touch(self, msg) -> None:
+        # GIL-atomic dict store; read by the digestion thread's liveness sweep
+        self._last_seen[msg["partition_id"]] = time.time()
+
     def _reg_callback(self, msg) -> Dict[str, Any]:
+        self._touch(msg)
         reregistered = self.server.reservations.register(
             msg["partition_id"], msg.get("meta", {})
         )
@@ -147,6 +181,7 @@ class HyperparameterOptDriver(Driver):
         return {"type": "OK"}
 
     def _get_callback(self, msg) -> Dict[str, Any]:
+        self._touch(msg)
         pid = msg["partition_id"]
         assignment = self.server.reservations.get_assignment(pid)
         if assignment is not None:
@@ -155,10 +190,12 @@ class HyperparameterOptDriver(Driver):
             if trial is not None:
                 return {"type": "TRIAL", "trial_id": trial.trial_id, "params": trial.params}
         if self.experiment_done.is_set() or self.abort.is_set():
+            self._gstop_sent.add(pid)
             return {"type": "GSTOP"}
         return {"type": "IDLE"}
 
     def _metric_callback(self, msg) -> Dict[str, Any]:
+        self._touch(msg)
         self.server.enqueue(msg)
         if self.abort.is_set():
             # interrupt every broadcasting train_fn so aborted experiments do not
@@ -173,6 +210,7 @@ class HyperparameterOptDriver(Driver):
         return {"type": "OK"}
 
     def _final_callback(self, msg) -> Dict[str, Any]:
+        self._touch(msg)
         # unassign synchronously (event loop), before the reply: the worker's
         # next GET must never see its finished trial still assigned, or it
         # would run it twice (reference clears in the socket thread too,
@@ -200,18 +238,52 @@ class HyperparameterOptDriver(Driver):
         if msg.get("reregistered"):
             # worker restarted: its in-flight trial is lost
             # (reference rpc.py:415-437 -> optimization_driver.py:473-483)
-            assignment = self.server.reservations.get_assignment(pid)
-            if assignment is not None:
-                with self.lock:
-                    lost = self.trial_store.pop(assignment, None)
-                    if lost is not None:
-                        lost.error()
-                        self.final_store.append(lost)
-                if lost is not None:
-                    self._persist_trial(lost)
-                    self.log(f"Trial {assignment} lost with executor {pid}; marked ERROR")
-                self.server.reservations.assign_trial(pid, None)
+            self._lose_assignment(pid, f"executor {pid} re-registered")
         self._try_assign(pid)
+
+    def _lose_assignment(self, pid: int, reason: str) -> None:
+        """Free ``pid``'s in-flight trial: mark ERROR, persist, unassign.
+        Digestion thread only (controller-adjacent state)."""
+        assignment = self.server.reservations.get_assignment(pid)
+        if assignment is None:
+            return
+        with self.lock:
+            lost = self.trial_store.pop(assignment, None)
+            if lost is not None:
+                lost.error()
+                self.final_store.append(lost)
+        if lost is not None:
+            self._persist_trial(lost)
+            self.log(f"Trial {assignment} lost ({reason}); marked ERROR")
+        self.server.reservations.assign_trial(pid, None)
+
+    def _liveness_sweep(self) -> None:
+        """Pod mode: a registered worker silent past worker_timeout is
+        presumed dead — free its trial so the budget completes on the
+        remaining capacity (the reference gets this from Spark re-running the
+        executor task, spark_driver.py:136-145; nothing here aborts, so a
+        respawned worker — ``maggy_tpu.run --respawn`` — re-registers and
+        serves again)."""
+        timeout = getattr(self.config, "worker_timeout", 600.0)
+        now = time.time()
+        for pid, ts in list(self._last_seen.items()):
+            if now - ts <= timeout:
+                continue
+            # drop so the sweep fires once per death; a re-REG re-adds it
+            self._last_seen.pop(pid, None)
+            self._maybe_idle.discard(pid)
+            self.log(
+                f"Executor {pid} silent for {now - ts:.0f}s (> worker_timeout "
+                f"{timeout:.0f}s); freeing its trial and continuing on the "
+                "remaining workers"
+            )
+            self._lose_assignment(pid, f"executor {pid} presumed dead")
+        # a dead worker must not strand completion once the budget is spent
+        if self._optimizer_exhausted:
+            with self.lock:
+                in_flight = len(self.trial_store)
+            if in_flight == 0 and not self.experiment_done.is_set():
+                self._finish_experiment()
 
     def _digest_metric(self, msg) -> None:
         trial_id, metric, step = msg.get("trial_id"), msg.get("metric"), msg.get("step")
@@ -254,6 +326,10 @@ class HyperparameterOptDriver(Driver):
         with self.lock:
             trial = self.trial_store.pop(trial_id, None)
         if trial is None:
+            # duplicate FINAL, or a live worker the liveness sweep falsely
+            # presumed dead (its trial was already freed): the worker is
+            # healthy and unassigned — reschedule it, or it idles forever
+            self._try_assign(pid)
             return
         if msg.get("error"):
             trial.error()
@@ -282,6 +358,8 @@ class HyperparameterOptDriver(Driver):
         self._try_assign(pid)
 
     def _on_tick(self) -> None:
+        if self.pod_mode:
+            self._liveness_sweep()
         # retry partitions that previously got IDLE (reference
         # optimization_driver.py:542-568 debounced retries)
         for pid in list(self._maybe_idle):
@@ -452,9 +530,65 @@ class HyperparameterOptDriver(Driver):
                 best=best,
                 controller_log=list(self._controller_tail),
             )
+            if self.pod_mode:
+                # dict() snapshot: the digestion thread's liveness sweep pops
+                # entries concurrently with this event-loop-thread iteration
+                base.update(
+                    last_seen={
+                        str(pid): round(time.time() - ts, 1)
+                        for pid, ts in dict(self._last_seen).items()
+                    }
+                )
         return base
 
     # ------------------------------------------------------------------ executor
+
+    def _await_completion(self) -> None:
+        super()._await_completion()
+        if not self.pod_mode or self.abort.is_set():
+            return
+        # linger until every LIVE remote worker's next GET has seen GSTOP —
+        # tearing the server down the instant the local executor returns
+        # turns a cleanly finished study into an RpcError for any worker
+        # sleeping between GETs (it would then exit nonzero and burn a
+        # --respawn slot on a doomed replacement). Dead workers are excluded
+        # by heartbeat freshness; the wait is bounded regardless.
+        fresh = max(2.0, 4 * getattr(self.config, "hb_interval", 1.0))
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            now = time.time()
+            waiting = [
+                pid
+                for pid, ts in dict(self._last_seen).items()
+                if now - ts < fresh and pid not in self._gstop_sent
+            ]
+            if not waiting:
+                return
+            time.sleep(0.05)
+
+    def _local_partitions(self) -> List[int]:
+        if not self.pod_mode:
+            return super()._local_partitions()
+        import socket as socket_mod
+
+        self.log(
+            f"Pod mode: HPO driver at {socket_mod.gethostname()}:"
+            f"{self.server.port} (secret via MAGGY_TPU_SECRET), running local "
+            f"trial executor 0; remote workers add capacity as they register"
+        )
+        return [0]
+
+    def _device_groups(self) -> List[list]:
+        if not self.pod_mode:
+            return super()._device_groups()
+        # the local executor spans this host's devices; remote workers lease
+        # their own hosts' devices themselves
+        try:
+            import jax
+
+            return [jax.local_devices()]
+        except Exception:
+            return [[]]
 
     def _executor_fn(self, train_fn: Callable, partition_id: int, devices: list) -> Callable:
         return trial_executor_fn(

@@ -251,19 +251,14 @@ def _connect_with_deadline(
             delay = min(delay * 1.5, 5.0)
 
 
-def run_worker(
-    train_fn: Callable, config, host: str, port: int, secret: str,
-    via_registry: bool = False,
-) -> Any:
-    """Run this process as one pod worker; returns the worker's outputs."""
+def _bootstrap_ids(
+    host: str, port: int, pid: int, secret: str, via_registry: bool
+) -> Tuple[str, int]:
+    """Fetch the driver's app/run ids so this worker's artifacts land in the
+    driver's experiment directory (env vars override)."""
     from maggy_tpu import util
-    from maggy_tpu.core.executors.distributed import dist_executor_fn
 
-    pid = partition_id()
     connect_timeout = float(os.environ.get("MAGGY_TPU_CONNECT_TIMEOUT", "120"))
-
-    # pre-flight: fetch the driver's app/run ids so this worker's artifacts
-    # land in the driver's experiment directory (env vars override)
     app_id = os.environ.get("MAGGY_TPU_APP_ID")
     run_id = os.environ.get("MAGGY_TPU_RUN_ID")
     if app_id is None or run_id is None:
@@ -275,7 +270,18 @@ def run_worker(
             run_id = run_id or cfg_reply.get("run_id") or 1
         finally:
             probe.stop()
-    run_id = int(run_id)
+    return app_id, int(run_id)
+
+
+def run_worker(
+    train_fn: Callable, config, host: str, port: int, secret: str,
+    via_registry: bool = False,
+) -> Any:
+    """Run this process as one pod worker; returns the worker's outputs."""
+    from maggy_tpu.core.executors.distributed import dist_executor_fn
+
+    pid = partition_id()
+    app_id, run_id = _bootstrap_ids(host, port, pid, secret, via_registry)
     executor = dist_executor_fn(
         train_fn=train_fn,
         config=config,
@@ -289,3 +295,54 @@ def run_worker(
     )
     executor()
     return {"role": "worker", "partition_id": pid}
+
+
+def run_trial_worker(
+    train_fn: Callable, config, host: str, port: int, secret: str,
+    via_registry: bool = False,
+) -> Any:
+    """Run this process as one remote TRIAL executor for an HPO/ablation
+    experiment (reference parity: Spark runs trial executors on cluster
+    hosts, spark_driver.py:136-145 + trial_executor.py:35-213; here any host
+    running the same script with MAGGY_TPU_ROLE=worker adds trial capacity).
+    Loops {register → GET → run trial → FINAL} until the driver answers
+    GSTOP. A driver that has already finished and torn down its server reads
+    as a graceful stop, not a crash."""
+    from maggy_tpu.core.executors.trial import trial_executor_fn
+    from maggy_tpu.exceptions import RpcError
+
+    pid = partition_id()
+    app_id, run_id = _bootstrap_ids(host, port, pid, secret, via_registry)
+    resolve = None
+    study = getattr(config, "ablation_study", None)
+    if study is not None:
+        # the worker holds the same AblationConfig the driver does, so the
+        # model/dataset variant resolver is rebuilt host-side
+        from maggy_tpu.core.driver.ablation import make_ablation_resolver
+
+        resolve = make_ablation_resolver(study)
+    executor = trial_executor_fn(
+        train_fn=train_fn,
+        config=config,
+        app_id=app_id,
+        run_id=run_id,
+        partition_id=pid,
+        server_addr=(host, port),
+        secret=secret,
+        devices=None,  # spans this host's devices
+        resolve=resolve,
+    )
+    try:
+        executor()
+    except RpcError as e:
+        # the driver is unreachable mid-loop. Normal completion is NOT this
+        # path (the driver answers GSTOP before tearing its server down), so
+        # propagate: the process exits nonzero and a supervisor
+        # (maggy_tpu.run --respawn) can put the capacity back — swallowing
+        # here would read as a clean exit and defeat the respawn.
+        print(
+            f"[maggy_tpu pod worker {pid}] driver unreachable ({e}); exiting "
+            "for the supervisor to respawn"
+        )
+        raise
+    return {"role": "trial_worker", "partition_id": pid}

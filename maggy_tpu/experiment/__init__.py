@@ -134,25 +134,29 @@ def _(config: DistributedConfig, app_id: str, run_id: int):
 
 def _maybe_run_as_pod_worker(train_fn: Callable, config) -> Optional[Any]:
     """Pod mode: non-zero hosts run a worker against the process-0 driver
-    instead of their own driver (core/pod.py)."""
+    instead of their own driver (core/pod.py). DistributedConfig workers join
+    the collective training run; HPO/ablation workers run a remote TRIAL
+    executor loop — the reference's Spark-executor trial placement
+    (spark_driver.py:136-145), elastic here: workers may join late, die, and
+    re-register (``maggy_tpu.run --respawn``) without aborting the study."""
     import os
 
-    if not isinstance(config, DistributedConfig):
-        if os.environ.get("MAGGY_TPU_ROLE") == "worker":
-            # an HPO/ablation script under a pod launcher would otherwise run
-            # N whole independent experiments
-            raise RuntimeError(
-                "MAGGY_TPU_ROLE=worker is only meaningful for DistributedConfig "
-                "experiments; HPO/ablation parallelize inside one driver — run "
-                f"this script as a single process (got {type(config).__name__})."
-            )
+    distributed = isinstance(config, DistributedConfig)
+    if not distributed and not (
+        os.environ.get("MAGGY_TPU_ROLE") == "worker"
+        or getattr(config, "driver_addr", None)
+        or os.environ.get("MAGGY_TPU_DRIVER")
+    ):
+        # plain single-process HPO/ablation: never touch worker_role (it may
+        # consult jax.process_index, pointlessly initializing a backend)
         return None
     from maggy_tpu.core import pod
 
     role = pod.worker_role(config)
     if role is None:
         return None
-    return pod.run_worker(
+    run = pod.run_worker if distributed else pod.run_trial_worker
+    return run(
         train_fn, config, role.host, role.port, role.secret,
         via_registry=role.via_registry,
     )

@@ -42,9 +42,39 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _spawn_rank(args, env_gen, generation: int, rank: int, port: int, tag=""):
+    """Start one rank's process with the generation's wiring."""
+    env = dict(env_gen)
+    env["MAGGY_TPU_ROLE"] = "driver" if rank == 0 else "worker"
+    env["MAGGY_TPU_PARTITION"] = str(rank)
+    if rank == 0:
+        env["MAGGY_TPU_BIND_PORT"] = str(port)
+    stdout = stderr = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        stdout = open(
+            os.path.join(args.log_dir, f"rank{rank}.g{generation}{tag}.out"), "wb"
+        )
+        stderr = open(
+            os.path.join(args.log_dir, f"rank{rank}.g{generation}{tag}.err"), "wb"
+        )
+    proc = subprocess.Popen(
+        [sys.executable, args.script, *args.script_args],
+        env=env,
+        stdout=stdout,
+        stderr=stderr,
+    )
+    if stdout is not None:
+        stdout.close()
+        stderr.close()
+    return proc
+
+
 def _spawn_generation(args, base_env, generation: int):
     """Start all ranks for one generation. Fresh driver/coordinator ports per
-    generation: the previous generation's sockets may linger in TIME_WAIT."""
+    generation: the previous generation's sockets may linger in TIME_WAIT.
+    Returns (procs, env_gen, port) so single ranks can be respawned into the
+    same generation (--respawn)."""
     port = _free_port()
     env_gen = dict(base_env)
     env_gen.update(
@@ -58,30 +88,8 @@ def _spawn_generation(args, base_env, generation: int):
 
     procs = {}
     for rank in range(args.workers):
-        env = dict(env_gen)
-        env["MAGGY_TPU_ROLE"] = "driver" if rank == 0 else "worker"
-        env["MAGGY_TPU_PARTITION"] = str(rank)
-        if rank == 0:
-            env["MAGGY_TPU_BIND_PORT"] = str(port)
-        stdout = stderr = None
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            stdout = open(
-                os.path.join(args.log_dir, f"rank{rank}.g{generation}.out"), "wb"
-            )
-            stderr = open(
-                os.path.join(args.log_dir, f"rank{rank}.g{generation}.err"), "wb"
-            )
-        procs[rank] = subprocess.Popen(
-            [sys.executable, args.script, *args.script_args],
-            env=env,
-            stdout=stdout,
-            stderr=stderr,
-        )
-        if stdout is not None:
-            stdout.close()
-            stderr.close()
-    return procs
+        procs[rank] = _spawn_rank(args, env_gen, generation, rank, port)
+    return procs, env_gen, port
 
 
 def _terminate_all(procs, grace: float = 5.0) -> None:
@@ -127,6 +135,17 @@ def main(argv=None) -> int:
         "from their latest checkpoint",
     )
     parser.add_argument(
+        "--respawn",
+        type=int,
+        default=0,
+        metavar="MAX_RESPAWNS",
+        help="on a WORKER rank death, respawn just that rank into the live "
+        "experiment (up to MAX_RESPAWNS total) — worker capacity recovery "
+        "for HPO/ablation trial workers, which re-register with the "
+        "running driver and keep serving trials. Driver (rank 0) death "
+        "still tears the run down (or restarts it under --elastic).",
+    )
+    parser.add_argument(
         "--log-dir",
         default=None,
         help="capture each rank's stdout/stderr to "
@@ -157,18 +176,59 @@ def main(argv=None) -> int:
         base_env.setdefault("MAGGY_TPU_RUN_ID", "1")
 
     generation = 0
-    procs = _spawn_generation(args, base_env, generation)
+    procs, env_gen, port = _spawn_generation(args, base_env, generation)
     exit_code = 0
+    respawns_used = 0
     try:
         remaining = dict(procs)
         while remaining:
             restart = failed = False
             for rank in list(remaining):
+                if rank not in remaining:
+                    continue  # removed by the driver-done wind-down below
                 code = remaining[rank].poll()
                 if code is None:
                     continue
                 del remaining[rank]
                 if code == 0:
+                    if rank == 0:
+                        # the driver finished the experiment: workers have
+                        # nothing left to serve (a respawned trial worker may
+                        # even be stuck in its connect-retry window against
+                        # the now-closed server) — wind them down
+                        deadline = time.time() + 10
+                        while remaining and time.time() < deadline:
+                            for r in list(remaining):
+                                if remaining[r].poll() is not None:
+                                    del remaining[r]
+                            time.sleep(0.1)
+                        if remaining:
+                            print(
+                                f"[maggy_tpu.run] driver done; terminating "
+                                f"lingering worker rank(s) {sorted(remaining)}",
+                                file=sys.stderr,
+                            )
+                            _terminate_all(remaining)
+                            remaining = {}
+                    continue
+                if rank != 0 and respawns_used < args.respawn and 0 in remaining:
+                    # the driver is still up: put this worker's capacity back
+                    # (it re-registers with a fresh attempt nonce; the driver
+                    # frees any trial it was holding). With the driver gone
+                    # there is nothing to rejoin — fall through to teardown.
+                    respawns_used += 1
+                    print(
+                        f"[maggy_tpu.run] worker rank {rank} exited with "
+                        f"{code}; respawning into the live experiment "
+                        f"({args.respawn - respawns_used} respawn(s) left)",
+                        file=sys.stderr,
+                    )
+                    proc = _spawn_rank(
+                        args, env_gen, generation, rank, port,
+                        tag=f".r{respawns_used}",
+                    )
+                    procs[rank] = proc
+                    remaining[rank] = proc
                     continue
                 if generation < args.elastic:
                     print(
@@ -195,7 +255,7 @@ def main(argv=None) -> int:
             if restart:
                 _terminate_all(procs)
                 generation += 1
-                procs = _spawn_generation(args, base_env, generation)
+                procs, env_gen, port = _spawn_generation(args, base_env, generation)
                 remaining = dict(procs)
                 continue
             time.sleep(0.1)
