@@ -328,12 +328,7 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         if cfg.decode:
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "the KV-cache decode path has no segment masking; "
-                    "prefill packed batches with decode=False"
-                )
-            out = self._cached_attention(q, k, v, positions)
+            out = self._cached_attention(q, k, v, positions, segment_ids)
         else:
             attn = cfg.attention_fn or auto_attention
             out = attn(q, k, v, causal=True, segment_ids=segment_ids)
@@ -356,7 +351,7 @@ class Attention(nn.Module):
         )(out)
         return out
 
-    def _cached_attention(self, q, k, v, positions):
+    def _cached_attention(self, q, k, v, positions, segment_ids=None):
         """Incremental decoding: append this chunk's K/V to a cache of
         ``max_seq_len`` and attend the chunk's queries over everything cached
         so far (the KV-cache path the recompute-based generate() lacks).
@@ -366,7 +361,15 @@ class Attention(nn.Module):
         stops after the last WRITTEN chunk, so per-step HBM traffic — the
         decode bottleneck — is proportional to the actual prefix, not
         ``max_seq_len``. Online-softmax across chunks (same recurrence as
-        ops.attention) keeps the math exact."""
+        ops.attention) keeps the math exact.
+
+        Packed batches (VERDICT r4 item 4): with ``segment_ids`` a packed
+        prompt prefills in ONE pass — the ids are cached alongside K/V and
+        every read is masked to the query's segment, so segments cannot
+        attend across their boundaries. Later single-token steps may omit
+        ``segment_ids``; once the ``seg`` track exists the new token extends
+        the row's most recent segment. Unpacked flows never create the track
+        and keep the exact original compute."""
         cfg = self.cfg
         b, t, kh, hd = k.shape
         k_cache = self.variable(
@@ -391,6 +394,28 @@ class Attention(nn.Module):
         v_cache.value = v_all
         index.value = idx + t
 
+        # packed-segment track: static trace-time decision (flax variable
+        # presence), so unpacked decode pays nothing
+        seg_all = seg_q = None
+        if segment_ids is not None or self.has_variable("cache", "seg"):
+            seg_cache = self.variable(
+                "cache", "seg",
+                lambda: jnp.zeros((b, cfg.max_seq_len), jnp.int32),
+            )
+            if segment_ids is None:
+                # continuation: the new token(s) extend the most recent
+                # segment written for the row
+                last = jax.lax.dynamic_slice_in_dim(
+                    seg_cache.value, jnp.maximum(idx - 1, 0), 1, axis=1
+                )
+                seg_q = jnp.broadcast_to(last, (b, t))
+            else:
+                seg_q = segment_ids.astype(jnp.int32)
+            seg_all = jax.lax.dynamic_update_slice(
+                seg_cache.value, seg_q, (0, idx)
+            )
+            seg_cache.value = seg_all
+
         S = cfg.max_seq_len
         chunk = min(cfg.decode_chunk, S)
         while S % chunk:  # dynamic_slice must never clamp past the end
@@ -404,15 +429,33 @@ class Attention(nn.Module):
         # never be position-shifted by end-clamping (over-long prompt buffers)
         n_valid = jnp.minimum((written + chunk - 1) // chunk, S // chunk)
 
+        # a query's own write location in the cache; for packed rows this is
+        # the causal clock (``positions`` restart per segment there, so they
+        # cannot order keys across the whole cache)
+        qslot = idx + jnp.arange(t)
+
         def body(ci, carry):
             k_c = jax.lax.dynamic_slice_in_dim(k_all, ci * chunk, chunk, axis=1)
             v_c = jax.lax.dynamic_slice_in_dim(v_all, ci * chunk, chunk, axis=1)
             kpos = ci * chunk + jnp.arange(chunk)
-            # causal over the cache: a query at position p sees keys at <= p
-            # that have actually been written
-            mask = (
-                kpos[None, None, None, :] <= positions[:, None, :, None]
-            ) & (kpos < written)[None, None, None, :]
+            if seg_all is None:
+                # causal over the cache: a query at position p sees keys at
+                # <= p that have actually been written (positions == cache
+                # slots on this path)
+                mask = (
+                    kpos[None, None, None, :] <= positions[:, None, :, None]
+                ) & (kpos < written)[None, None, None, :]
+            else:
+                # packed: causal in CACHE ORDER (packing preserves a row's
+                # temporal order) and restricted to the query's own segment
+                seg_c = jax.lax.dynamic_slice_in_dim(
+                    seg_all, ci * chunk, chunk, axis=1
+                )
+                mask = (
+                    (kpos[None, None, None, :] <= qslot[None, None, :, None])
+                    & (kpos < written)[None, None, None, :]
+                    & (seg_c[:, None, None, :] == seg_q[:, None, :, None])
+                )
             return ops_attn.online_block_update(
                 carry,
                 q,

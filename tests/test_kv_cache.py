@@ -187,3 +187,93 @@ def test_tp_decode_cache_sharded():
             outs.append(np.asarray(logits[:, 0]))
     inc = np.stack(outs, axis=1)
     np.testing.assert_allclose(inc, full, atol=2e-2)
+
+
+@pytest.mark.slow
+def test_packed_prefill_logits_match_per_sequence(setup):
+    """VERDICT r4 item 4: a packed prompt batch prefills in ONE pass, and the
+    segment mask isolates each segment — every segment's prefill logits
+    equal a plain forward over that sequence alone."""
+    from maggy_tpu.models.generate import prefill
+
+    cfg, model, decode_model, variables, _ = setup
+    rng = np.random.default_rng(3)
+    s1 = rng.integers(1, cfg.vocab_size, 6).astype(np.int32)
+    s2 = rng.integers(1, cfg.vocab_size, 10).astype(np.int32)
+    packed = jnp.asarray(np.concatenate([s1, s2])[None])  # [1, 16]
+    positions = jnp.asarray(
+        np.concatenate([np.arange(6), np.arange(10)])[None].astype(np.int32)
+    )
+    seg = jnp.asarray(np.concatenate([np.zeros(6), np.ones(10)])[None].astype(np.int32))
+
+    logits, cache = prefill(
+        decode_model, variables["params"], packed, positions, seg
+    )
+    # every scanned layer's write index advanced by the full prompt length
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if "index" in jax.tree_util.keystr(path):
+            assert all(int(v) == 16 for v in np.asarray(leaf).ravel())
+    ref1 = np.asarray(model.apply(variables, jnp.asarray(s1[None])))
+    ref2 = np.asarray(model.apply(variables, jnp.asarray(s2[None])))
+    got = np.asarray(logits)
+    np.testing.assert_allclose(got[:, :6], ref1, atol=3e-2)
+    np.testing.assert_allclose(got[:, 6:], ref2, atol=3e-2)
+
+
+@pytest.mark.slow
+def test_packed_prefill_decode_matches_unpacked_decode(setup):
+    """Packed prefill + cached decode of each row's LAST segment equals the
+    per-sequence unpacked cached decode — greedy tokens must match exactly."""
+    from maggy_tpu.models.generate import generate_cached_packed
+
+    cfg, model, decode_model, variables, _ = setup
+    rng = np.random.default_rng(4)
+    MAX_NEW = 6
+    rows = []
+    poss = []
+    segs = []
+    lasts = []
+    for r in range(2):
+        a = rng.integers(1, cfg.vocab_size, 5).astype(np.int32)
+        b = rng.integers(1, cfg.vocab_size, 7).astype(np.int32)
+        rows.append(np.concatenate([a, b]))
+        poss.append(np.concatenate([np.arange(5), np.arange(7)]))
+        segs.append(np.concatenate([np.zeros(5), np.ones(7)]))
+        lasts.append(b)
+    packed = jnp.asarray(np.stack(rows).astype(np.int32))
+    positions = jnp.asarray(np.stack(poss).astype(np.int32))
+    seg = jnp.asarray(np.stack(segs).astype(np.int32))
+
+    _, new_tokens = generate_cached_packed(
+        decode_model, variables["params"], packed, positions, seg,
+        max_new=MAX_NEW,
+    )
+
+    # unpacked reference: each last segment decoded alone through the
+    # existing cached path
+    for r, b in enumerate(lasts):
+        buf = np.zeros((1, 7 + MAX_NEW), np.int32)
+        buf[0, :7] = b
+        ref = generate_cached(
+            decode_model, variables["params"], jnp.asarray(buf),
+            jnp.asarray([7], jnp.int32),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(new_tokens)[r], np.asarray(ref)[0, 7:],
+            err_msg=f"row {r}: packed continuation diverges from unpacked",
+        )
+
+
+@pytest.mark.slow
+def test_packed_prefill_cache_overflow_raises(setup):
+    from maggy_tpu.models.generate import generate_cached_packed
+
+    cfg, model, decode_model, variables, _ = setup
+    packed = jnp.zeros((1, 30), jnp.int32)
+    positions = jnp.zeros((1, 30), jnp.int32)
+    seg = jnp.zeros((1, 30), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate_cached_packed(
+            decode_model, variables["params"], packed, positions, seg,
+            max_new=8,
+        )
