@@ -408,6 +408,110 @@ def pipeline_grads_1f1b(
     return loss, grads
 
 
+def pipeline_forward_loss(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    targets,
+    *,
+    mesh,
+    axis_name: str = AXIS_STAGE,
+    first_fn: Optional[Callable] = None,
+    stage_takes_raw: bool = False,
+    stage_has_aux: bool = False,
+):
+    """Forward-only GPipe sweep returning ``(loss, aux)`` microbatch means —
+    the EVAL counterpart of :func:`pipeline_grads_1f1b` (VERDICT r4 item 9):
+    per-device live state is one stage's params plus a single microbatch
+    activation, instead of unstacking the whole model replicated on every
+    device (which OOMs exactly in the regime pipeline parallelism exists
+    for). Same stage_fn/loss_fn/first_fn contracts as the 1F1B schedule;
+    no gradients, no activation ring — M + S - 1 ticks."""
+    if first_fn is None:
+        first_fn = lambda params, raw: raw  # noqa: E731 - identity ingest
+    base_stage = (
+        stage_fn if stage_takes_raw else (lambda p, x, raw: stage_fn(p, x))
+    )
+    if stage_has_aux:
+        run_stage = base_stage
+    else:
+        run_stage = lambda p, x, raw: (base_stage(p, x, raw), jnp.float32(0))  # noqa: E731
+    S = mesh.shape[axis_name]
+    M = microbatches.shape[0]
+    if S == 1:
+        def one(params):
+            p0 = jax.tree.map(lambda q: q[0], params)
+
+            def per_micro(x, t):
+                y, aux = run_stage(p0, first_fn(p0, x), x)
+                return loss_fn(p0, y, t), aux
+
+            data, aux = jax.vmap(per_micro)(microbatches, targets)
+            return data.mean(), aux.mean()
+
+        return one(stage_params)
+
+    def local(params, mbs, tgts):
+        params = jax.tree.map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis_name)
+        is_last = stage == S - 1
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        act = jax.eval_shape(
+            first_fn, params, jax.ShapeDtypeStruct(mbs.shape[1:], mbs.dtype)
+        )
+        zeros_mb = jnp.zeros(act.shape, act.dtype)
+
+        def tick(carry, t):
+            y_recv, loss_acc, aux_acc = carry
+            m = jnp.clip(t - stage, 0, M - 1)
+            do = ((t - stage) >= 0) & ((t - stage) < M)
+            raw = jax.lax.dynamic_index_in_dim(mbs, m, keepdims=False)
+            x = jnp.where(stage == 0, first_fn(params, raw), y_recv)
+
+            def run(raw, x):
+                y, aux = run_stage(params, x, raw)
+                tgt = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(a, m, keepdims=False),
+                    tgts,
+                )
+                lval = jax.lax.cond(
+                    is_last,
+                    lambda: loss_fn(params, y, tgt).astype(jnp.float32),
+                    lambda: jnp.float32(0),
+                )
+                return y, lval, aux.astype(jnp.float32)
+
+            def skip(raw, x):
+                return zeros_mb, jnp.float32(0), jnp.float32(0)
+
+            y, lval, aval = jax.lax.cond(do, run, skip, raw, x)
+            y_next = jax.lax.ppermute(y, axis_name, fwd_perm)
+            return (y_next, loss_acc + lval, aux_acc + aval), None
+
+        init = (zeros_mb, jnp.float32(0), jnp.float32(0))
+        (_, loss_acc, aux_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(M + S - 1)
+        )
+        dpf = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+
+        def reduce_scalar(v):
+            v = jax.lax.psum(v, axis_name)
+            return jax.lax.psum(v, (AXIS_DATA, AXIS_FSDP)) / (dpf * M)
+
+        return reduce_scalar(loss_acc), reduce_scalar(aux_acc)
+
+    batch_spec = P(None, (AXIS_DATA, AXIS_FSDP))
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis_name), batch_spec, batch_spec),
+        out_specs=(P(), P()),
+        axis_names=_manual_axes(mesh, axis_name),
+        check_vma=False,
+    )(stage_params, microbatches, targets)
+
+
 def stack_stage_params(per_layer_params, n_stages: int):
     """Reshape layer-stacked params ``[L, ...]`` into ``[n_stages, L//n_stages,
     ...]`` for :func:`pipeline_apply` (shard the leading axis over 'stage')."""

@@ -282,7 +282,10 @@ class Trainer:
         # attention shard_maps; placing them (batch, seq) up front avoids an
         # XLA full-rematerialization reshard per step. Like params_shardings,
         # degrade to the batch-only placement when the length doesn't divide
-        # the seq axis (non-SP attention paths have no divisibility demand)
+        # the seq axis (non-SP attention paths have no divisibility demand).
+        # Multi-process meshes place these too: shard_batch slices each
+        # process's seq chunk from the sharding's own index map (r5; was a
+        # per-step all-gather on the flagship long-context path before).
         seq_keys = ("segment_ids", "positions")
         seq_ext = shd.mesh_extent(
             self.mesh, shd.logical_to_mesh_axes(("activation_seq",), self.rules)[0]
@@ -295,11 +298,6 @@ class Trainer:
             if (
                 key in seq_keys
                 and seq_ext > 1
-                # multi-process: every process passes the FULL sequence, so a
-                # process-spanning seq placement would make
-                # make_array_from_process_local_data misread the local length
-                # as one chunk; keep batch-only placement there
-                and jax.process_count() == 1
                 and getattr(leaf, "ndim", 0) >= 2
                 and leaf.shape[1] % seq_ext == 0
             ):
@@ -326,23 +324,127 @@ class Trainer:
             return jax.device_put(batch, shardings)
         import numpy as np
 
-        pid, n = jax.process_index(), jax.process_count()
+        default = shd.batch_sharding(self.mesh, self.rules)
+
+        def process_block(s, shape):
+            """This process's contiguous [start, stop) block per array dim,
+            straight from the sharding's own index map — correct for any
+            mesh/process layout, including a seq axis that spans processes."""
+            idx_map = s.addressable_devices_indices_map(shape)
+            block = []
+            for d in range(len(shape)):
+                starts = [sl[d].start or 0 for sl in idx_map.values()]
+                stops = [
+                    shape[d] if sl[d].stop is None else sl[d].stop
+                    for sl in idx_map.values()
+                ]
+                block.append(slice(min(starts), max(stops)))
+            return tuple(block)
 
         def put(x, s):
             x = np.asarray(x)
-            if not local:
-                if x.shape[0] % n:
-                    raise ValueError(
-                        f"Global batch dim {x.shape[0]} not divisible by "
-                        f"{n} processes"
-                    )
-                per = x.shape[0] // n
-                x = x[pid * per : (pid + 1) * per]
-            return jax.make_array_from_process_local_data(s, x)
+            if local:
+                # a rank-sharding loader pre-slices ROWS only; it cannot also
+                # slice a process-spanning seq chunk — keep batch placement
+                # for inner-sharded leaves
+                spec = getattr(s, "spec", ())
+                if len(spec) > 1 and any(a is not None for a in spec[1:]):
+                    s = default
+                return jax.make_array_from_process_local_data(s, x)
+            # every process passes the same GLOBAL array; carve out exactly
+            # this process's block per the sharding's own index map. This is
+            # the general rule the old rows/process_count slicing was a
+            # special case of — and unlike it, stays correct when the batch
+            # axis does NOT span processes (e.g. an sp-only mesh, where every
+            # process must supply the full replicated batch) and when the
+            # seq axis DOES (each process carves its seq chunk).
+            return jax.make_array_from_process_local_data(
+                s, np.ascontiguousarray(x[process_block(s, x.shape)]), x.shape
+            )
 
         return jax.tree.map(put, batch, shardings)
 
     # ------------------------------------------------------------------ steps
+
+    def _pp_batch_parts(self, batch, parts, n_micro: int, dpf: int):
+        """Shared pipeline plumbing for the 1F1B train step AND the
+        forward-only eval sweep: microbatch the batch, build the raw channel
+        stream (packed side inputs ride as int channels so every stage can
+        mask/position its attention), and close over the last-stage loss —
+        including the packed/masked rescale that keeps per-microbatch masked
+        means equal to the dense global mask-weighted mean.
+        Returns ``(raw_microbatches, targets, loss_pp)``."""
+        tokens = _model_inputs(batch)[0]
+        bsz = tokens.shape[0]
+        if bsz % n_micro:
+            raise ValueError(
+                f"batch size {bsz} not divisible by n_microbatches "
+                f"{n_micro}; set Trainer(n_microbatches=...) to a divisor"
+            )
+        if (bsz // n_micro) % dpf:
+            raise ValueError(
+                f"each of the {n_micro} microbatches has {bsz // n_micro} "
+                f"rows, which must divide the mesh's data x fsdp extent "
+                f"({dpf}); grow the batch or lower n_microbatches"
+            )
+
+        def split(a):
+            return a.reshape((n_micro, bsz // n_micro) + a.shape[1:])
+
+        def eff_mask(b):
+            """lm_loss_fn's effective target mask for a (sub)batch:
+            loss_mask AND same-segment — must mirror lm_loss_fn exactly
+            so the rescale below cancels its local denominator."""
+            m = None
+            lm = b.get("loss_mask")
+            if lm is not None:
+                m = lm[:, 1:].astype(jnp.float32)
+            sg = b.get("segment_ids")
+            if sg is not None:
+                same = (sg[:, 1:] == sg[:, :-1]).astype(jnp.float32)
+                m = same if m is None else m * same
+            return m
+
+        tgts = jax.tree.map(split, batch)
+        mask_norm = None
+        if self.loss_fn is lm_loss_fn and isinstance(batch, dict):
+            m = eff_mask(batch)
+            if m is not None:
+                # global effective-mask sum, for rescaling per-microbatch
+                # masked means back to the dense objective — segment
+                # boundaries count too, or microbatches with uneven packing
+                # would be mis-weighted
+                mask_norm = jnp.maximum(m.sum(), 1.0)
+
+        def loss_pp(stage_params, y, tgt):
+            loss = self.loss_fn(parts.head_fn(stage_params, y), tgt)
+            if mask_norm is not None:
+                local = jnp.maximum(eff_mask(tgt).sum(), 1.0)
+                # the schedule divides the psum of these by dpf*n_micro;
+                # this rescale makes the total sum(ll*mask)/global_sum
+                loss = loss * local * (dpf * n_micro) / mask_norm
+            return loss
+
+        if isinstance(batch, dict) and (
+            "segment_ids" in batch or "positions" in batch
+        ):
+            # positions-only batches stack 2 channels — a zeros segment-id
+            # channel would needlessly disable the flash kernel's
+            # segment_ids-is-None fast path
+            positions = batch.get("positions")
+            if positions is None:
+                positions = jnp.broadcast_to(
+                    jnp.arange(tokens.shape[1], dtype=tokens.dtype),
+                    tokens.shape,
+                )
+            channels = [tokens, positions.astype(tokens.dtype)]
+            seg = batch.get("segment_ids")
+            if seg is not None:
+                channels.append(seg.astype(tokens.dtype))
+            raw = jnp.stack(channels, axis=-1)
+        else:
+            raw = tokens
+        return split(raw), tgts, loss_pp
 
     def _build_pp_train_step(self):
         """1F1B pipeline training step (mesh has stage>1): microbatch the
@@ -364,85 +466,14 @@ class Trainer:
         dpf = shape.get(shd.AXIS_DATA, 1) * shape.get(shd.AXIS_FSDP, 1)
 
         def train_step(state: TrainState, batch):
-            tokens = _model_inputs(batch)[0]
-            bsz = tokens.shape[0]
-            if bsz % n_micro:
-                raise ValueError(
-                    f"batch size {bsz} not divisible by n_microbatches "
-                    f"{n_micro}; set Trainer(n_microbatches=...) to a divisor"
-                )
-            if (bsz // n_micro) % dpf:
-                raise ValueError(
-                    f"each of the {n_micro} microbatches has {bsz // n_micro} "
-                    f"rows, which must divide the mesh's data x fsdp extent "
-                    f"({dpf}); grow the batch or lower n_microbatches"
-                )
-
-            def split(a):
-                return a.reshape((n_micro, bsz // n_micro) + a.shape[1:])
-
-            def eff_mask(b):
-                """lm_loss_fn's effective target mask for a (sub)batch:
-                loss_mask AND same-segment — must mirror lm_loss_fn exactly
-                so the rescale below cancels its local denominator."""
-                t = _model_inputs(b)[0]
-                m = None
-                lm = b.get("loss_mask")
-                if lm is not None:
-                    m = lm[:, 1:].astype(jnp.float32)
-                sg = b.get("segment_ids")
-                if sg is not None:
-                    same = (sg[:, 1:] == sg[:, :-1]).astype(jnp.float32)
-                    m = same if m is None else m * same
-                return m
-
-            tgts = jax.tree.map(split, batch)
-            mask_norm = None
-            if self.loss_fn is lm_loss_fn and isinstance(batch, dict):
-                m = eff_mask(batch)
-                if m is not None:
-                    # global effective-mask sum, for rescaling per-microbatch
-                    # masked means back to the dense objective (docstring
-                    # above) — segment boundaries count too, or microbatches
-                    # with uneven packing would be mis-weighted
-                    mask_norm = jnp.maximum(m.sum(), 1.0)
-
-            def loss_pp(stage_params, y, tgt):
-                loss = self.loss_fn(parts.head_fn(stage_params, y), tgt)
-                if mask_norm is not None:
-                    local = jnp.maximum(eff_mask(tgt).sum(), 1.0)
-                    # primitive divides the psum of these by dpf*n_micro;
-                    # this rescale makes the total sum(ll*mask)/global_sum
-                    loss = loss * local * (dpf * n_micro) / mask_norm
-                return loss
-
-            if isinstance(batch, dict) and (
-                "segment_ids" in batch or "positions" in batch
-            ):
-                # packed sequences: side inputs ride the raw stream as int
-                # channels so every stage can mask/position its attention.
-                # positions-only batches stack 2 channels — a zeros
-                # segment-id channel would needlessly disable the flash
-                # kernel's segment_ids-is-None fast path
-                positions = batch.get("positions")
-                if positions is None:
-                    positions = jnp.broadcast_to(
-                        jnp.arange(tokens.shape[1], dtype=tokens.dtype),
-                        tokens.shape,
-                    )
-                channels = [tokens, positions.astype(tokens.dtype)]
-                seg = batch.get("segment_ids")
-                if seg is not None:
-                    channels.append(seg.astype(tokens.dtype))
-                raw = jnp.stack(channels, axis=-1)
-            else:
-                raw = tokens
-
+            split_raw, tgts, loss_pp = self._pp_batch_parts(
+                batch, parts, n_micro, dpf
+            )
             out = pipeline_grads_1f1b(
                 parts.stage_fn,
                 loss_pp,
                 state.params,
-                split(raw),
+                split_raw,
                 tgts,
                 mesh=self.mesh,
                 first_fn=parts.first_fn,
@@ -510,10 +541,16 @@ class Trainer:
             return self._train_step(state, batch)
 
     def eval_logits(self, state: TrainState, batch):
+        """Full logits for one batch.
+
+        MEMORY CAVEAT under pp>1: the stage-stacked params are unstacked and
+        the whole model runs replicated per device — fine for tests/small
+        models, an HBM spike at the scale pipeline parallelism exists for.
+        Prefer :meth:`evaluate` there (forward-only pipelined loss, live
+        bytes bounded by ~1 stage); full-logit extraction at scale should go
+        through a checkpoint into a non-pp serving mesh."""
         if self._eval_step is None:
             if self.pp > 1:
-                # stage-stacked params don't fit model.apply; run the full
-                # (unstacked) model replicated — eval is occasional and small
                 parts = self._pipeline_parts()
 
                 def eval_step(state, batch):
@@ -529,17 +566,38 @@ class Trainer:
 
     def evaluate(self, state: TrainState, data_iter, num_batches: int) -> Dict[str, float]:
         """Mean loss over ``num_batches`` held-out batches (no state update).
-        The loss is computed inside jit so full logits never leave the device."""
+        The loss is computed inside jit so full logits never leave the
+        device. Under pp>1 the loss flows through the pipeline stages
+        (forward-only GPipe sweep, VERDICT r4 item 9) — per-device live
+        bytes stay bounded by one stage's params + a microbatch activation,
+        never the unstacked full model."""
         if num_batches < 1:
             raise ValueError("evaluate needs num_batches >= 1")
         if self._eval_loss_step is None:
             if self.pp > 1:
+                from maggy_tpu.parallel.pipeline import pipeline_forward_loss
+
                 parts = self._pipeline_parts()
+                n_micro = self.n_microbatches or 2 * parts.n_stages
+                shape = dict(self.mesh.shape)
+                dpf = shape.get(shd.AXIS_DATA, 1) * shape.get(shd.AXIS_FSDP, 1)
 
                 def eval_loss(state, batch):
-                    params = parts.unstack(state.params)
-                    logits = self.model.apply({"params": params}, *_model_inputs(batch))
-                    return self.loss_fn(logits, batch)
+                    split_raw, tgts, loss_pp = self._pp_batch_parts(
+                        batch, parts, n_micro, dpf
+                    )
+                    loss, _aux = pipeline_forward_loss(
+                        parts.stage_fn,
+                        loss_pp,
+                        state.params,
+                        split_raw,
+                        tgts,
+                        mesh=self.mesh,
+                        first_fn=parts.first_fn,
+                        stage_takes_raw=True,
+                        stage_has_aux=parts.stage_has_aux,
+                    )
+                    return loss
             else:
                 def eval_loss(state, batch):
                     logits = state.apply_fn({"params": state.params}, *_model_inputs(batch))

@@ -191,3 +191,110 @@ def test_decoder_trainer_packed_end_to_end():
         state, m = trainer.step(state, trainer.shard_batch(batch))
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
+
+
+def test_packed_side_inputs_seq_sharded_no_remat(capfd):
+    """VERDICT r4 item 5: on an sp mesh the packed side inputs must be
+    PLACED (batch, seq) by shard_batch, so XLA never has to involuntarily
+    rematerialize them per step. Oracle: XLA's own 'Involuntary full
+    rematerialization' SPMD warning — absent with the trainer's placement,
+    present (positive control) when the same inputs are forced batch-only."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.parallel.ringattention import make_ring_attention
+    from maggy_tpu.parallel.spec import ShardingSpec
+    from maggy_tpu.train import TrainContext
+
+    # the warning fires at partition time only — a persistent-cache hit
+    # would silently skip it and blind both arms of the test
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        B, S = 4, 128
+        ctx = TrainContext.create(ShardingSpec(fsdp=2, sp=4))
+        cfg = DecoderConfig.tiny(attention_fn=make_ring_attention(ctx.mesh))
+        trainer = ctx.trainer(Decoder(cfg), optax.adamw(1e-3))
+        rng = np.random.default_rng(0)
+        seg = np.zeros((B, S), np.int32)
+        seg[:, S // 2:] = 1
+        pos = (
+            np.concatenate([np.arange(S // 2), np.arange(S - S // 2)])[None]
+            .repeat(B, 0)
+            .astype(np.int32)
+        )
+        batch = {
+            "tokens": rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32),
+            "positions": pos,
+            "segment_ids": seg,
+        }
+        state = trainer.make_state(jax.random.key(0), batch)
+        step = trainer._build_train_step()
+
+        sb = trainer.shard_batch(batch)
+        assert sb["segment_ids"].sharding.spec == P(("data", "fsdp"), "seq")
+        assert sb["positions"].sharding.spec == P(("data", "fsdp"), "seq")
+
+        capfd.readouterr()  # drain
+        with trainer.mesh:
+            step.lower(state, sb).compile()
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" not in err, err[-1500:]
+
+        # positive control: the batch-only placement this replaced DOES trip
+        # the warning — proving the oracle detects the regression
+        bo = NamedSharding(trainer.mesh, P(("data", "fsdp")))
+        sb_old = dict(sb)
+        sb_old["segment_ids"] = jax.device_put(seg, bo)
+        sb_old["positions"] = jax.device_put(pos, bo)
+        with trainer.mesh:
+            step.lower(state, sb_old).compile()
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" in err
+
+        # numerics are placement-independent (fresh states: step donates)
+        _, m_new = trainer.step(state, sb)
+        state2 = trainer.make_state(jax.random.key(0), batch)
+        _, m_old = trainer.step(state2, sb_old)
+        assert abs(float(m_new["loss"]) - float(m_old["loss"])) < 1e-5
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+
+
+def test_padded_packed_row_needs_loss_mask():
+    """ADVICE r4 / docs 'Padding convention': a trailing pad region that
+    shares a segment id still attends within itself and contributes
+    next-token loss — `loss_mask` is what removes it. Locks both facts: the
+    unmasked padded loss differs from the true loss; the masked one matches
+    the unpadded row exactly."""
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.train.trainer import lm_loss_fn
+
+    cfg = DecoderConfig.tiny()
+    rng = np.random.default_rng(2)
+    S, PAD = 24, 8
+    doc = rng.integers(1, cfg.vocab_size, S).astype(np.int32)
+    model = Decoder(cfg)
+    variables = model.init(jax.random.key(1), jnp.asarray(doc[None]))
+
+    # unpadded reference
+    jb_ref = {"tokens": jnp.asarray(doc[None])}
+    ref = float(lm_loss_fn(model.apply(variables, jb_ref["tokens"]), jb_ref))
+
+    # padded row: pad gets its OWN segment id (so it cannot attend into the
+    # document), but without a loss_mask its intra-pad targets still count
+    padded = np.concatenate([doc, np.zeros(PAD, np.int32)])
+    seg = np.concatenate([np.zeros(S), np.ones(PAD)]).astype(np.int32)
+    pos = np.concatenate([np.arange(S), np.arange(PAD)]).astype(np.int32)
+    jb = {
+        "tokens": jnp.asarray(padded[None]),
+        "segment_ids": jnp.asarray(seg[None]),
+        "positions": jnp.asarray(pos[None]),
+    }
+    logits = model.apply(variables, jb["tokens"], jb["positions"], jb["segment_ids"])
+    unmasked = float(lm_loss_fn(logits, jb))
+    assert abs(unmasked - ref) > 1e-3  # pad leaks into the objective
+
+    mask = np.concatenate([np.ones(S), np.zeros(PAD)]).astype(np.float32)
+    masked = float(lm_loss_fn(logits, {**jb, "loss_mask": jnp.asarray(mask[None])}))
+    np.testing.assert_allclose(masked, ref, atol=2e-3)  # mask restores truth

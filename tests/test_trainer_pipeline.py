@@ -395,3 +395,78 @@ def test_pp_tp_moe_trains():
     assert np.isfinite(losses[-1])
     assert losses[-1] < losses[0]
     assert float(m["aux_loss"]) > 0
+
+
+def test_pp_pipelined_eval_loss_bounded_memory():
+    """VERDICT r4 item 9: evaluate() under pp computes the loss THROUGH the
+    pipeline stages (forward-only sweep) — matching the dense loss, with
+    compiled temp memory well under the unstack-everything eval it
+    replaced (at scale the dominant win is never materializing the full
+    replicated param set)."""
+    cfg = DecoderConfig.tiny()
+    batch = _batch(cfg)
+    ctx = TrainContext.create(ShardingSpec(pp=2, dp=4))
+    trainer = ctx.trainer(Decoder(cfg), optax.adamw(1e-2), n_microbatches=2)
+    state = trainer.make_state(jax.random.key(0), batch)
+    for _ in range(3):
+        state, _ = trainer.step(state, trainer.shard_batch(batch))
+
+    parts = trainer._pipeline_parts()
+    dense_params = jax.device_get(jax.jit(parts.unstack)(state.params))
+    jb = {k: jnp.asarray(v) for k, v in batch.items()}
+    ref = float(
+        lm_loss_fn(Decoder(cfg).apply({"params": dense_params}, jb["tokens"]), jb)
+    )
+    res = trainer.evaluate(state, iter([batch] * 2), 2)
+    assert abs(res["loss"] - ref) < 2e-3
+
+    # live-bytes bound: the pipelined eval's compiled temp allocation must be
+    # well under the replicated-unstack eval it replaced
+    def replicated_eval(state, b):
+        params = parts.unstack(state.params)
+        return lm_loss_fn(Decoder(cfg).apply({"params": params}, b["tokens"]), b)
+
+    sb = trainer.shard_batch(batch)
+    with trainer.mesh:
+        pip = trainer._eval_loss_step.lower(state, sb).compile()
+        rep = jax.jit(replicated_eval).lower(state, sb).compile()
+    pip_temp = pip.memory_analysis().temp_size_in_bytes
+    rep_temp = rep.memory_analysis().temp_size_in_bytes
+    assert pip_temp < rep_temp * 0.6, (pip_temp, rep_temp)
+
+
+def test_pp_pipelined_eval_packed_matches_dense():
+    """Packed batches evaluate through the pipeline too: side inputs ride
+    the raw channel stream, and the masked global-mean rescale keeps the
+    reported loss equal to the dense packed loss."""
+    cfg = DecoderConfig.tiny()
+    B, S = 8, 32
+    rng = np.random.default_rng(1)
+    seg = np.zeros((B, S), np.int32)
+    seg[:4, S // 2:] = 1  # rows 0-3 packed, rows 4-7 single-doc
+    pos = np.stack(
+        [np.concatenate([np.arange(S // 2), np.arange(S - S // 2)])] * 4
+        + [np.arange(S)] * 4
+    ).astype(np.int32)
+    batch = {
+        "tokens": rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32),
+        "positions": pos,
+        "segment_ids": seg,
+    }
+    ctx = TrainContext.create(ShardingSpec(pp=2, dp=4))
+    trainer = ctx.trainer(Decoder(cfg), optax.sgd(1e-1), n_microbatches=2)
+    state = trainer.make_state(jax.random.key(0), batch)
+    for _ in range(4):
+        state, _ = trainer.step(state, trainer.shard_batch(batch))
+
+    parts = trainer._pipeline_parts()
+    dense_params = jax.device_get(jax.jit(parts.unstack)(state.params))
+    jb = {k: jnp.asarray(v) for k, v in batch.items()}
+    ref = float(lm_loss_fn(
+        Decoder(cfg).apply(
+            {"params": dense_params}, jb["tokens"], jb["positions"], jb["segment_ids"]
+        ),
+        jb,
+    ))
+    res = trainer.evaluate(state, iter([batch] * 2), 2)
+    assert abs(res["loss"] - ref) < 2e-3
