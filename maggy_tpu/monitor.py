@@ -73,6 +73,20 @@ def render_status(status: dict, width: int = 78) -> str:
                 f"best {status.get('direction', '')} "
                 f"{best['metric']:.6g}  ({best['trial_id']})  {params}"[:width]
             )
+        seen = status.get("last_seen") or {}
+        if seen:  # pod-mode HPO: remote trial workers' heartbeat ages
+            def pid_key(kv):
+                try:
+                    return (0, int(kv[0]))
+                except ValueError:
+                    return (1, kv[0])
+
+            lines.append(
+                "last heartbeat: "
+                + "  ".join(
+                    f"w{pid}:{age}s" for pid, age in sorted(seen.items(), key=pid_key)
+                )
+            )
         tail = status.get("controller_log") or []
         if tail:
             lines.append(f"-- {status.get('controller', 'controller')} decisions --")

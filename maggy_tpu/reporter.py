@@ -29,21 +29,33 @@ from maggy_tpu import exceptions
 # capture into their own reporters without racing on builtins.
 
 _print_local = threading.local()
-_orig_print = builtins.print
-_tee_installed = False
 _tee_lock = threading.Lock()
+_active_captures = 0
+_saved_print = None  # whatever print was when the tee went in (install time)
+# recursion-proof fallback if a foreign hook holds a stale tee reference
+_builtin_print = builtins.print
 
 
 def _tee_print(*args, **kwargs):
-    reporter = getattr(_print_local, "reporter", None)
-    if reporter is not None and kwargs.get("file") is None:
-        try:
-            reporter.log(
-                kwargs.get("sep", " ").join(str(a) for a in args), verbose=False
-            )
-        except Exception:  # noqa: BLE001 - printing must never raise
-            pass
-    _orig_print(*args, **kwargs)
+    # reentrancy guard: if a foreign wrapper captured a stale tee reference
+    # and a NEW capture saved that wrapper as _saved_print, the chain
+    # tee -> wrapper -> stale tee would recurse forever without this
+    if getattr(_print_local, "in_tee", False):
+        _builtin_print(*args, **kwargs)
+        return
+    _print_local.in_tee = True
+    try:
+        reporter = getattr(_print_local, "reporter", None)
+        if reporter is not None and kwargs.get("file") is None:
+            try:
+                reporter.log(
+                    kwargs.get("sep", " ").join(str(a) for a in args), verbose=False
+                )
+            except Exception:  # noqa: BLE001 - printing must never raise
+                pass
+        (_saved_print or _builtin_print)(*args, **kwargs)
+    finally:
+        _print_local.in_tee = False
 
 
 @contextlib.contextmanager
@@ -54,18 +66,31 @@ def capture_prints(reporter: "Reporter"):
     Scope note vs the reference's process-wide swap: only THIS thread's
     prints are captured — threads a train_fn spawns itself (data loaders,
     callbacks) go to stdout only. That's the price of running executors as
-    threads in one process; spawned workers should log via ``reporter``."""
-    global _tee_installed
+    threads in one process; spawned workers should log via ``reporter``.
+
+    Install/uninstall is reference-counted: the tee wraps whatever
+    ``builtins.print`` is when the FIRST capture enters (so a hook installed
+    before us keeps working), and ``builtins.print`` is restored when the
+    LAST capture exits — unless someone wrapped the tee in the meantime, in
+    which case their chain is left untouched."""
+    global _active_captures, _saved_print
     with _tee_lock:
-        if not _tee_installed:
+        if _active_captures == 0:
+            _saved_print = builtins.print
             builtins.print = _tee_print
-            _tee_installed = True
+        _active_captures += 1
     prev = getattr(_print_local, "reporter", None)
     _print_local.reporter = reporter
     try:
         yield
     finally:
         _print_local.reporter = prev
+        with _tee_lock:
+            _active_captures -= 1
+            if _active_captures == 0:
+                if builtins.print is _tee_print:
+                    builtins.print = _saved_print
+                _saved_print = None
 
 
 class Reporter:
@@ -82,6 +107,8 @@ class Reporter:
         # buffer the whole log and publish once at close() via the env seam
         self._remote_log = bool(log_file) and "://" in str(log_file)
         self._log_history: List[str] = []
+        self._remote_truncated = 0
+        self._remote_logged = 0
         self._log_fd = (
             open(log_file, "a", buffering=1)
             if log_file and not self._remote_log
@@ -146,30 +173,63 @@ class Reporter:
 
     # ------------------------------------------------------------------ logging
 
+    # object stores can't append: the remote log republishes the accumulated
+    # buffer every _REMOTE_FLUSH_EVERY lines (so a crashed executor loses at
+    # most one window, not the whole log) and caps memory at
+    # _REMOTE_MAX_LINES with an explicit truncation notice
+    _REMOTE_FLUSH_EVERY = 256
+    _REMOTE_MAX_LINES = 20_000
+
     def log(self, message: str, verbose: bool = True) -> None:
         """Buffer a log line for shipping to the driver; optionally echo locally."""
         line = str(message)
+        snapshot = None
         with self._lock:
             self._logs.append(line)
             if self._log_fd:
                 self._log_fd.write(line.rstrip("\n") + "\n")
             elif self._remote_log:
                 self._log_history.append(line.rstrip("\n"))
+                self._remote_logged += 1  # monotonic: the capped buffer's
+                # length pins at MAX_LINES, which would otherwise stop the
+                # periodic flush condition from ever firing again
+                if len(self._log_history) > self._REMOTE_MAX_LINES:
+                    dropped = len(self._log_history) - self._REMOTE_MAX_LINES
+                    self._log_history = self._log_history[dropped:]
+                    self._remote_truncated += dropped
+                if self._remote_logged % self._REMOTE_FLUSH_EVERY == 0:
+                    snapshot = self._remote_snapshot()
+        if snapshot is not None:
+            self._publish_remote(snapshot)  # network IO outside the lock
         if verbose and self._print_hook:
             self._print_hook(line)
+
+    def _remote_snapshot(self) -> str:
+        head = (
+            [f"... [{self._remote_truncated} earlier lines truncated] ..."]
+            if self._remote_truncated
+            else []
+        )
+        return "\n".join(head + self._log_history) + "\n"
+
+    def _publish_remote(self, content: str) -> None:
+        from maggy_tpu.core.env import EnvSing
+
+        try:
+            EnvSing.get_instance().dump(content, self._log_file)
+        except Exception:  # noqa: BLE001 - logs are best-effort
+            pass
 
     def close(self) -> None:
         with self._lock:
             if self._log_fd:
                 self._log_fd.close()
                 self._log_fd = None
-            if self._remote_log and self._log_history:
-                from maggy_tpu.core.env import EnvSing
-
-                try:
-                    EnvSing.get_instance().dump(
-                        "\n".join(self._log_history) + "\n", self._log_file
-                    )
-                except Exception:  # noqa: BLE001 - logs are best-effort
-                    pass
-                self._log_history = []
+            snapshot = (
+                self._remote_snapshot()
+                if self._remote_log and self._log_history
+                else None
+            )
+            self._log_history = []
+        if snapshot is not None:
+            self._publish_remote(snapshot)

@@ -68,3 +68,133 @@ def test_log_file(tmp_path):
     r.log("line2", verbose=False)
     r.close()
     assert p.read_text().splitlines() == ["line1", "line2"]
+
+
+def test_capture_prints_restores_builtin_print():
+    """ADVICE r4: builtins.print must be restored once the LAST capture
+    exits (no permanent process-wide swap), and the tee must wrap whatever
+    print was installed when the first capture entered."""
+    import builtins
+
+    from maggy_tpu.reporter import Reporter, capture_prints
+
+    before = builtins.print
+    r1, r2 = Reporter(), Reporter()
+    with capture_prints(r1):
+        assert builtins.print is not before  # tee installed
+        with capture_prints(r2):
+            print("inner")
+        assert builtins.print is not before  # r1 still active
+        print("outer")
+    assert builtins.print is before  # fully restored
+    _, _, _, logs1 = r1.get_data()
+    assert "outer" in logs1
+    _, _, _, logs2 = r2.get_data()
+    assert "inner" in logs2
+
+
+def test_capture_prints_leaves_foreign_wrapper():
+    """A hook installed ON TOP of the tee mid-capture is not clobbered at
+    uninstall; the refcount drops our state without touching their chain."""
+    import builtins
+
+    from maggy_tpu.reporter import Reporter, capture_prints
+
+    before = builtins.print
+    r = Reporter()
+    with capture_prints(r):
+        inner = builtins.print
+
+        def foreign(*a, **k):
+            inner(*a, **k)
+
+        builtins.print = foreign
+    assert builtins.print is foreign  # their wrapper survives
+    builtins.print = before  # cleanup
+
+
+def test_remote_log_periodic_flush_and_truncation(monkeypatch):
+    """ADVICE r4: a remote (object-store) log root publishes periodically —
+    a crash loses at most one window — and the in-memory buffer is capped
+    with an explicit truncation notice."""
+    import uuid
+
+    from maggy_tpu.core import env as env_mod
+    from maggy_tpu.core.env.gcs import GcsEnv
+    from maggy_tpu.reporter import Reporter
+
+    env = GcsEnv(f"memory://rep-{uuid.uuid4().hex[:8]}")
+    env_mod.set_instance(env)
+    try:
+        monkeypatch.setattr(Reporter, "_REMOTE_FLUSH_EVERY", 4)
+        monkeypatch.setattr(Reporter, "_REMOTE_MAX_LINES", 10)
+        path = env.root + "/executor_0.log"
+        rep = Reporter(log_file=path, partition_id=0)
+        for i in range(4):
+            rep.log(f"line {i}")
+        # periodic flush happened BEFORE close
+        with env.open_file(path) as f:
+            assert "line 3" in f.read()
+        for i in range(4, 20):
+            rep.log(f"line {i}")
+        rep.close()
+        with env.open_file(path) as f:
+            final = f.read()
+        assert "truncated" in final        # cap enforced, loudly
+        assert "line 19" in final          # newest lines kept
+        assert "line 0" not in final       # oldest dropped
+    finally:
+        env_mod.set_instance(None)
+
+
+def test_remote_log_flush_continues_past_cap(monkeypatch):
+    """Regression: the periodic flush must keep firing after the buffer cap
+    pins len(history) — a monotonic counter, not the buffer length, drives
+    the cadence."""
+    import uuid
+
+    from maggy_tpu.core import env as env_mod
+    from maggy_tpu.core.env.gcs import GcsEnv
+    from maggy_tpu.reporter import Reporter
+
+    env = GcsEnv(f"memory://rep-{uuid.uuid4().hex[:8]}")
+    env_mod.set_instance(env)
+    try:
+        monkeypatch.setattr(Reporter, "_REMOTE_FLUSH_EVERY", 4)
+        monkeypatch.setattr(Reporter, "_REMOTE_MAX_LINES", 10)
+        path = env.root + "/executor_0.log"
+        rep = Reporter(log_file=path, partition_id=0)
+        for i in range(28):  # far past the cap; NO close()
+            rep.log(f"line {i}")
+        with env.open_file(path) as f:
+            content = f.read()
+        assert "line 27" in content  # flushed after the cap, without close
+        assert "truncated" in content
+    finally:
+        env_mod.set_instance(None)
+
+
+def test_capture_prints_survives_stale_tee_wrapper():
+    """Regression: a foreign wrapper that captured a stale tee reference
+    must not cause infinite recursion when a NEW capture saves that wrapper
+    as the 'original' print."""
+    import builtins
+
+    from maggy_tpu.reporter import Reporter, capture_prints
+
+    before = builtins.print
+    r1 = Reporter()
+    with capture_prints(r1):
+        stale_tee = builtins.print
+
+        def foreign(*a, **k):
+            stale_tee(*a, **k)  # closes over the tee
+
+        builtins.print = foreign
+    assert builtins.print is foreign
+    r2 = Reporter()
+    with capture_prints(r2):  # saves `foreign` (whose chain hits the tee)
+        print("no recursion")  # would RecursionError without the guard
+    _, _, _, logs = r2.get_data()
+    assert "no recursion" in logs
+    builtins.print = before  # cleanup
