@@ -41,6 +41,21 @@ def resolve_target(env, app_id=None):
     return host, int(rec["port"]), secret
 
 
+def _heartbeat_line(seen: dict) -> str:
+    """'last heartbeat: w0:1.2s w1:0.4s ...' — shared by the HPO and
+    distributed dashboard branches."""
+
+    def pid_key(kv):  # JSON stringifies pids; sort numerically
+        try:
+            return (0, int(kv[0]))
+        except ValueError:
+            return (1, kv[0])
+
+    return "last heartbeat: " + "  ".join(
+        f"w{pid}:{age}s" for pid, age in sorted(seen.items(), key=pid_key)
+    )
+
+
 def render_status(status: dict, width: int = 78) -> str:
     """Format a STATUS snapshot as a plain-ANSI dashboard panel (no external
     TUI dependency — the runtime image carries none)."""
@@ -75,18 +90,7 @@ def render_status(status: dict, width: int = 78) -> str:
             )
         seen = status.get("last_seen") or {}
         if seen:  # pod-mode HPO: remote trial workers' heartbeat ages
-            def pid_key(kv):
-                try:
-                    return (0, int(kv[0]))
-                except ValueError:
-                    return (1, kv[0])
-
-            lines.append(
-                "last heartbeat: "
-                + "  ".join(
-                    f"w{pid}:{age}s" for pid, age in sorted(seen.items(), key=pid_key)
-                )
-            )
+            lines.append(_heartbeat_line(seen))
         tail = status.get("controller_log") or []
         if tail:
             lines.append(f"-- {status.get('controller', 'controller')} decisions --")
@@ -103,18 +107,7 @@ def render_status(status: dict, width: int = 78) -> str:
         )
         seen = status.get("last_seen") or {}
         if seen:
-            def pid_key(kv):  # JSON stringifies pids; sort numerically
-                try:
-                    return (0, int(kv[0]))
-                except ValueError:
-                    return (1, kv[0])
-
-            lines.append(
-                "last heartbeat: "
-                + "  ".join(
-                    f"w{pid}:{age}s" for pid, age in sorted(seen.items(), key=pid_key)
-                )
-            )
+            lines.append(_heartbeat_line(seen))
     return "\n".join(lines)
 
 

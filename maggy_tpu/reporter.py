@@ -87,9 +87,12 @@ def capture_prints(reporter: "Reporter"):
         _print_local.reporter = prev
         with _tee_lock:
             _active_captures -= 1
-            if _active_captures == 0:
-                if builtins.print is _tee_print:
-                    builtins.print = _saved_print
+            if _active_captures == 0 and builtins.print is _tee_print:
+                # only on an ACTUAL restore: if a foreign hook wrapped the
+                # tee we leave their chain alone — including _saved_print,
+                # which the orphaned tee still forwards through (dropping it
+                # would silently bypass any hook installed before us)
+                builtins.print = _saved_print
                 _saved_print = None
 
 
@@ -109,6 +112,13 @@ class Reporter:
         self._log_history: List[str] = []
         self._remote_truncated = 0
         self._remote_logged = 0
+        # publish sequencing: snapshots are taken under self._lock but
+        # DUMPED outside it (network IO must not stall broadcasts); the seq
+        # guard stops a preempted older snapshot from overwriting a newer one
+        self._publish_lock = threading.Lock()
+        self._publish_seq = 0
+        self._published_seq = 0
+        self._remote_closed = False
         self._log_fd = (
             open(log_file, "a", buffering=1)
             if log_file and not self._remote_log
@@ -188,7 +198,7 @@ class Reporter:
             self._logs.append(line)
             if self._log_fd:
                 self._log_fd.write(line.rstrip("\n") + "\n")
-            elif self._remote_log:
+            elif self._remote_log and not self._remote_closed:
                 self._log_history.append(line.rstrip("\n"))
                 self._remote_logged += 1  # monotonic: the capped buffer's
                 # length pins at MAX_LINES, which would otherwise stop the
@@ -200,25 +210,31 @@ class Reporter:
                 if self._remote_logged % self._REMOTE_FLUSH_EVERY == 0:
                     snapshot = self._remote_snapshot()
         if snapshot is not None:
-            self._publish_remote(snapshot)  # network IO outside the lock
+            self._publish_remote(*snapshot)  # network IO outside the lock
         if verbose and self._print_hook:
             self._print_hook(line)
 
-    def _remote_snapshot(self) -> str:
+    def _remote_snapshot(self):
+        """(seq, content) under self._lock; seq orders concurrent publishes."""
         head = (
             [f"... [{self._remote_truncated} earlier lines truncated] ..."]
             if self._remote_truncated
             else []
         )
-        return "\n".join(head + self._log_history) + "\n"
+        self._publish_seq += 1
+        return self._publish_seq, "\n".join(head + self._log_history) + "\n"
 
-    def _publish_remote(self, content: str) -> None:
+    def _publish_remote(self, seq: int, content: str) -> None:
         from maggy_tpu.core.env import EnvSing
 
-        try:
-            EnvSing.get_instance().dump(content, self._log_file)
-        except Exception:  # noqa: BLE001 - logs are best-effort
-            pass
+        with self._publish_lock:
+            if seq <= self._published_seq:
+                return  # a newer snapshot already landed; never regress
+            try:
+                EnvSing.get_instance().dump(content, self._log_file)
+                self._published_seq = seq
+            except Exception:  # noqa: BLE001 - logs are best-effort
+                pass
 
     def close(self) -> None:
         with self._lock:
@@ -231,5 +247,7 @@ class Reporter:
                 else None
             )
             self._log_history = []
+            self._remote_closed = True  # later flushes must not republish a
+            # near-empty buffer over the complete final log
         if snapshot is not None:
-            self._publish_remote(snapshot)
+            self._publish_remote(*snapshot)

@@ -123,3 +123,32 @@ def test_lagom_hyperband_e2e(tmp_env):
     assert result["num_trials"] == 9 + 3 + 1 + 5 + 1 + 3
     assert set(budgets_seen) == {1, 3, 9}
     assert result["errors"] == 0
+
+
+@pytest.mark.slow
+def test_hyperband_fleet_scale_stress():
+    """VERDICT r4 item 6: 16 simulated executors, ~264 trials, 5%
+    stragglers, through the REAL controllers (the driver's one-decision-
+    at-a-time discipline). Locks three facts: concurrent cycles
+    (iterations=N) beat the pre-knob serial-cycle behavior on both idle
+    fraction and makespan under stragglers; and the controller sustains
+    far more decisions/sec than a 16-executor fleet can consume — the
+    _pending gate is consumed within one get_suggestion call and never
+    throttles."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from stress_hyperband import run_suite
+
+    r = run_suite(n_executors=16, straggler=0.05, cycles=12)
+    conc = r["hyperband_concurrent_cycles"]
+    serial = r["hyperband_serial_cycles"]
+    assert conc["trials"] == serial["trials"]
+    assert conc["idle_fraction"] < serial["idle_fraction"] - 0.25
+    assert conc["makespan"] < 0.7 * serial["makespan"]
+    # scheduling overhead: a 16-executor fleet finishing a trial every
+    # 100ms consumes one decision per 6.25ms. Allow a 10x tracing/CI-load
+    # slowdown over the measured ~0.5ms and still demand the controller
+    # beats the fleet's own consumption rate
+    assert conc["controller_s_per_decision_us"] < 6250
