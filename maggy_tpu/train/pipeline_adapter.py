@@ -36,11 +36,10 @@ from maggy_tpu.models.transformer import (
 
 
 def _pp_local_attention(q, k, v, *, causal: bool = True, segment_ids=None):
-    """Attention inside the pipeline's shard_map must be device-local (a
-    nested shard_map / collective would be invalid): the single-device Pallas
-    flash kernel on TPU when the geometry tiles onto the MXU, the XLA dense
-    path otherwise — the same dispatch as auto_attention minus the mesh
-    logic."""
+    """Attention inside the pipeline's shard_map must be device-local (the
+    stage/data/fsdp axes are manual): the single-device Pallas flash kernel
+    on TPU when the geometry tiles onto the MXU, the XLA dense path
+    otherwise — the same dispatch as auto_attention minus the mesh logic."""
     from maggy_tpu.ops.flash import flash_attention  # late: import cycle
 
     b, s, h, d = q.shape
@@ -52,6 +51,52 @@ def _pp_local_attention(q, k, v, *, causal: bool = True, segment_ids=None):
     ):
         return flash_attention(q, k, v, causal=causal)
     return default_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+
+
+def _make_pp_tp_attention(tp: int):
+    """Stage-local attention for pp x tp: a NESTED shard_map manual over the
+    `tensor` axis (legal inside the pipeline's partial-manual region, where
+    `tensor` is GSPMD-auto) splits the head axis so each tensor shard runs
+    the single-device kernel — the Pallas flash path on TPU — on its own
+    H/tp heads. Attention is embarrassingly parallel over heads, so there is
+    no collective to insert and nothing for GSPMD to partition through an
+    opaque custom call. Falls back to the GSPMD einsum path when the head
+    counts don't split."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from maggy_tpu.parallel.spec import AXIS_TENSOR
+
+    def attn(q, k, v, *, causal: bool = True, segment_ids=None):
+        h, kh = q.shape[2], k.shape[2]
+        if h % tp or kh % tp:
+            return default_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        head_spec = P(None, None, AXIS_TENSOR, None)
+        segmented = segment_ids is not None
+
+        def local(q, k, v, seg):
+            return _pp_local_attention(
+                q, k, v, causal=causal, segment_ids=seg if segmented else None
+            )
+
+        seg_in = (
+            segment_ids
+            if segmented
+            else jnp.zeros(q.shape[:2], jnp.int32)  # placeholder, never read
+        )
+        # mesh=None: inherit the CONTEXT mesh — inside the pipeline's
+        # partial-manual region that is the abstract mesh with
+        # stage/data/fsdp already Manual; passing the concrete Mesh there
+        # is rejected ("context mesh should match")
+        return jax.shard_map(
+            local,
+            in_specs=(head_spec, head_spec, head_spec, P()),
+            out_specs=head_spec,
+            axis_names=frozenset({AXIS_TENSOR}),
+            check_vma=False,
+        )(q, k, v, seg_in)
+
+    return attn
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,7 +123,7 @@ class DecoderPipelineParts:
 
 
 def decoder_pipeline_parts(
-    model: Any, n_stages: int, tp: int = 1
+    model: Any, n_stages: int, tp: int = 1, mesh=None
 ) -> DecoderPipelineParts:
     """Build the 1F1B parts for a :class:`Decoder`.
 
@@ -117,18 +162,25 @@ def decoder_pipeline_parts(
             "would silently untie. Use tie_embeddings=False under pp."
         )
     l_per = cfg.n_layers // n_stages
-    if tp > 1 and cfg.n_heads % tp:
+    if tp > 1 and (cfg.n_heads % tp or cfg.n_kv_heads % tp):
         raise ValueError(
-            f"n_heads={cfg.n_heads} not divisible by tp={tp}: the stage-local "
-            "attention shards the head axis over the tensor mesh axis"
+            f"n_heads={cfg.n_heads} / n_kv_heads={cfg.n_kv_heads} not "
+            f"divisible by tp={tp}: the stage-local attention shards BOTH "
+            "head axes over the tensor mesh axis (GQA kv heads included)"
         )
     # under pp x tp the stage body runs with the tensor axis in GSPMD-auto
     # mode; the Pallas flash kernel is an opaque custom call XLA cannot
-    # partition over the sharded head axis, so route to the XLA einsum
-    # attention, which GSPMD tensor-parallelizes like any other matmul
-    local_attn = (
-        default_attention if tp > 1 else _pp_local_attention
-    )
+    # partition over the sharded head axis, so a nested tensor-manual
+    # shard_map splits heads explicitly and runs the single-device kernel
+    # per shard (falls back to the GSPMD einsum path without a mesh)
+    if tp > 1:
+        # the nested map inherits the context mesh, but only Trainer-driven
+        # flows guarantee one — bare parts built without a mesh keep GSPMD
+        local_attn = (
+            _make_pp_tp_attention(tp) if mesh is not None else default_attention
+        )
+    else:
+        local_attn = _pp_local_attention
     stage_cfg = dataclasses.replace(
         cfg,
         n_layers=l_per,

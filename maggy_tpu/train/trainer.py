@@ -138,7 +138,8 @@ class Trainer:
                     "item 2)."
                 )
             self._pp_parts = decoder_pipeline_parts(
-                self.model, self.pp, tp=shape.get(AXIS_TENSOR, 1)
+                self.model, self.pp, tp=shape.get(AXIS_TENSOR, 1),
+                mesh=self.mesh,
             )
         return self._pp_parts
 

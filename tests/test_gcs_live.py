@@ -45,7 +45,9 @@ def _is_connectivity_error(exc: BaseException) -> bool:
     seen = set()
     while exc is not None and id(exc) not in seen:
         seen.add(id(exc))
-        if isinstance(exc, (socket.gaierror, ConnectionError, TimeoutError, OSError)):
+        # NOT bare OSError: gcsfs maps GCS-side failures to OSError
+        # subclasses (FileNotFoundError, PermissionError) that must FAIL
+        if isinstance(exc, (socket.gaierror, ConnectionError, TimeoutError)):
             return True
         if type(exc).__name__ in names:
             return True
