@@ -60,17 +60,14 @@ def _make_pp_tp_attention(tp: int):
     the single-device kernel — the Pallas flash path on TPU — on its own
     H/tp heads. Attention is embarrassingly parallel over heads, so there is
     no collective to insert and nothing for GSPMD to partition through an
-    opaque custom call. Falls back to the GSPMD einsum path when the head
-    counts don't split."""
+    opaque custom call. Head-count divisibility (q AND GQA kv) is enforced
+    by decoder_pipeline_parts before this is ever installed."""
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from maggy_tpu.parallel.spec import AXIS_TENSOR
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
-        h, kh = q.shape[2], k.shape[2]
-        if h % tp or kh % tp:
-            return default_attention(q, k, v, causal=causal, segment_ids=segment_ids)
         head_spec = P(None, None, AXIS_TENSOR, None)
         segmented = segment_ids is not None
 

@@ -147,8 +147,12 @@ def test_hyperband_fleet_scale_stress():
     assert conc["trials"] == serial["trials"]
     assert conc["idle_fraction"] < serial["idle_fraction"] - 0.25
     assert conc["makespan"] < 0.7 * serial["makespan"]
-    # scheduling-overhead backstop only (measured ~0.5ms/decision; a fleet
-    # consumes one per 6.25ms): the bound is set 100x above the measurement
-    # so coverage tracing / loaded CI hosts cannot flake it, while an
-    # accidental O(n^2) controller loop at 264 trials still trips it
-    assert conc["controller_s_per_decision_us"] < 50_000
+    # the controller must beat the fleet's own consumption rate (one
+    # decision per 6.25ms for 16 executors at 100ms/trial; measured
+    # ~0.5ms). Under sys.settrace-style instrumentation (coverage), pure-
+    # Python loops slow 10-30x — keep a backstop bound there instead of
+    # flaking, so an accidental O(n^2) controller loop still trips it
+    import sys as _sys
+
+    bound_us = 50_000 if _sys.gettrace() is not None else 6_250
+    assert conc["controller_s_per_decision_us"] < bound_us
