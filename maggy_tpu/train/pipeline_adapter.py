@@ -120,7 +120,7 @@ class DecoderPipelineParts:
 
 
 def decoder_pipeline_parts(
-    model: Any, n_stages: int, tp: int = 1, mesh=None
+    model: Any, n_stages: int, tp: int = 1, mesh=None, ep: int = 1
 ) -> DecoderPipelineParts:
     """Build the 1F1B parts for a :class:`Decoder`.
 
@@ -164,6 +164,12 @@ def decoder_pipeline_parts(
             f"n_heads={cfg.n_heads} / n_kv_heads={cfg.n_kv_heads} not "
             f"divisible by tp={tp}: the stage-local attention shards BOTH "
             "head axes over the tensor mesh axis (GQA kv heads included)"
+        )
+    if ep > 1 and is_moe and getattr(cfg, "n_experts", 0) % ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by ep={ep}: the "
+            "expert axis would silently replicate instead of sharding the "
+            "expert FFNs (pp x ep)"
         )
     # under pp x tp the stage body runs with the tensor axis in GSPMD-auto
     # mode; the Pallas flash kernel is an opaque custom call XLA cannot
@@ -301,7 +307,7 @@ def decoder_pipeline_parts(
     # when a tensor axis is real: at tp=1 the resolution could only ever
     # return the plain P('stage') placement, so skip the extra abstract init
     stage_names = None
-    if tp > 1:
+    if tp > 1 or ep > 1:
         pmodel = type(model)(dataclasses.replace(cfg, partition_params=True))
         abstract = jax.eval_shape(
             pmodel.init, jax.random.key(0), jnp.zeros((1, 8), jnp.int32)

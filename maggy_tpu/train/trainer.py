@@ -129,17 +129,21 @@ class Trainer:
             from maggy_tpu.train.pipeline_adapter import decoder_pipeline_parts
 
             shape = dict(self.mesh.shape)
-            bad = [a for a in (AXIS_SEQ, AXIS_EXPERT) if shape.get(a, 1) > 1]
-            if bad:
+            if shape.get(AXIS_SEQ, 1) > 1:
                 raise ValueError(
-                    f"pp>1 composes with dp/fsdp/tp only; mesh also has {bad} "
-                    "> 1. Stage params are placed P('stage', ...) — a "
-                    "seq/expert axis would silently replicate (VERDICT r3 "
-                    "item 2)."
+                    "pp>1 does not compose with sp>1: the 1F1B schedule runs "
+                    "each stage op under a lax.cond whose predicate varies "
+                    "per stage, and a seq-ring collective inside a "
+                    "non-uniform cond deadlocks (verified on the CPU mesh). "
+                    "Use pp x tp / pp x ep / pp x dp/fsdp, or sp without pp."
                 )
+            # pp composes with dp/fsdp (manual in the pipeline shard_maps)
+            # and with tp/ep: tensor/expert dims of the stage params stay
+            # GSPMD-managed, resolved from the model's own logical axes in
+            # state_shardings_for
             self._pp_parts = decoder_pipeline_parts(
                 self.model, self.pp, tp=shape.get(AXIS_TENSOR, 1),
-                mesh=self.mesh,
+                mesh=self.mesh, ep=shape.get(AXIS_EXPERT, 1),
             )
         return self._pp_parts
 
@@ -181,26 +185,29 @@ class Trainer:
 
             parts = self._pipeline_parts()
             n_stages = parts.n_stages
-            tp_ext = dict(self.mesh.shape).get(AXIS_TENSOR, 1)
+            mesh_shape = dict(self.mesh.shape)
+            # the pipeline shard_maps leave tensor AND expert in GSPMD-auto
+            # mode (parallel/pipeline.py _manual_axes), so those two — and
+            # only those — may shard stage-param dims (pp x tp, pp x ep)
+            auto_axes = {
+                a: mesh_shape.get(a, 1) for a in (AXIS_TENSOR, AXIS_EXPERT)
+            }
 
             def tensor_dims(names, shape):
-                """Mesh axes for a stage leaf's trailing dims: ONLY the
-                tensor axis is applied (pp x tp) — the pipeline shard_map is
-                manual over stage/data/fsdp with params replicated there, so
-                an fsdp/seq rule resolution would contradict its in_specs
-                and reshard every step."""
+                """Mesh axes for a stage leaf's trailing dims: only the
+                GSPMD-auto axes are applied — an fsdp/seq rule resolution
+                would contradict the pipeline shard_map's manual in_specs
+                (params replicated over data/fsdp) and reshard every step."""
                 table = dict(self.rules)
                 out = []
                 for name, dim in zip(names, shape):
                     ax = table.get(name) if name else None
-                    keep = ax == AXIS_TENSOR or (
-                        isinstance(ax, (tuple, list)) and tuple(ax) == (AXIS_TENSOR,)
-                    )
-                    out.append(
-                        AXIS_TENSOR
-                        if keep and tp_ext > 1 and dim % tp_ext == 0
-                        else None
-                    )
+                    if isinstance(ax, (tuple, list)):
+                        # multi-axis rules (e.g. (data, fsdp)) are never
+                        # auto axes here; also keeps lists unhashed
+                        ax = ax[0] if len(ax) == 1 else None
+                    ext = auto_axes.get(ax, 0)
+                    out.append(ax if ext > 1 and dim % ext == 0 else None)
                 return out
 
             def shard_of(leaf):

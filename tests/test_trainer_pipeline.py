@@ -276,11 +276,12 @@ def test_pp_raises_loudly_for_unsupported():
     with pytest.raises(ValueError, match="Decoder"):
         trainer.make_state(jax.random.key(0), {"inputs": np.zeros((8, 4), np.float32)})
 
-    # pp x sp would silently replicate stage params over seq: refuse
-    # (pp x tp is supported — see test_pp_tp_* below)
+    # pp x sp: a seq-ring collective inside the 1F1B schedule's per-stage
+    # lax.cond deadlocks (non-uniform predicate) — refuse loudly
+    # (pp x tp and pp x ep ARE supported — see test_pp_tp_* / test_pp_ep_*)
     ctx2 = TrainContext.create(ShardingSpec(pp=2, dp=2, sp=2))
     tr2 = ctx2.trainer(Decoder(cfg), optax.sgd(1e-2))
-    with pytest.raises(ValueError, match="dp/fsdp/tp"):
+    with pytest.raises(ValueError, match="does not compose with sp"):
         tr2.make_state(jax.random.key(0), batch)
 
     # layer count must split evenly into stages
@@ -506,3 +507,39 @@ def test_pp_tp_packed_matches_dense():
     )
     _, metrics = trainer.step(state, trainer.shard_batch(batch))
     assert abs(float(metrics["loss"]) - float(ref)) < 2e-3
+
+
+def test_pp_ep_moe_matches_dense():
+    """pp x ep: expert FFN weights shard over the expert axis INSIDE each
+    stage (GSPMD-auto in the pipeline's partial-manual region), and the
+    step matches the dense trainer's loss + router aux on the same params."""
+    from maggy_tpu.models import MoEConfig, MoEDecoder
+    from maggy_tpu.train.trainer import collect_aux_losses
+
+    cfg = MoEConfig.tiny_moe()
+    batch = _batch(cfg, bsz=8, seq=16)
+    ctx = TrainContext.create(ShardingSpec(pp=2, ep=2, dp=2))
+    trainer = ctx.trainer(MoEDecoder(cfg), optax.sgd(1e-2), n_microbatches=2)
+    state = trainer.make_state(jax.random.key(0), batch)
+
+    # placement: expert dims really sit on the expert axis
+    specs = {
+        jax.tree_util.keystr(p): leaf.sharding.spec
+        for p, leaf in jax.tree_util.tree_leaves_with_path(state.params)
+    }
+    assert any("expert" in str(s) for s in specs.values()), specs
+
+    parts = trainer._pipeline_parts()
+    dense_params = jax.device_get(jax.jit(parts.unstack)(state.params))
+    model = MoEDecoder(cfg)
+    logits, mods = model.apply(
+        {"params": dense_params}, jnp.asarray(batch["tokens"]),
+        mutable=["intermediates"],
+    )
+    ref_loss = float(lm_loss_fn(logits, batch))
+    ref_aux = float(collect_aux_losses(mods))
+
+    state, metrics = trainer.step(state, trainer.shard_batch(batch))
+    assert abs(float(metrics["loss"]) - ref_loss) < 2e-3
+    assert abs(float(metrics["aux_loss"]) - ref_aux) < 1e-3
+    assert float(metrics["aux_loss"]) > 0
