@@ -242,3 +242,26 @@ def test_lagom_injects_train_context(tmp_env):
     from maggy_tpu.train.trainer import TrainContext
 
     assert isinstance(seen["ctx"], TrainContext)
+
+
+@pytest.mark.slow
+def test_async_beats_bsp_wallclock():
+    """The reference's ONE published benchmark (DistributedML'20): async
+    trial assignment completes a fixed random-search budget in 33-58% less
+    wall-clock than synchronous BSP waves. Reproduced through the REAL
+    control plane (driver + RPC + executor threads) against the BSP cost of
+    the SAME per-trial durations. Conservative bounds: heavy-tailed trials
+    (the paper's regime) must clear 25%; even uniform durations must show
+    a double-digit win."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from bench_async_vs_bsp import bsp_wall, run_async
+
+    wall_u, durs_u = run_async(48, 8, "uniform", seed=1)
+    red_u = 1.0 - wall_u / bsp_wall(durs_u, 8)
+    wall_h, durs_h = run_async(48, 8, "heavy_tail", seed=1)
+    red_h = 1.0 - wall_h / bsp_wall(durs_h, 8)
+    assert red_h > 0.25, (red_h, wall_h)
+    assert red_u > 0.10, (red_u, wall_u)

@@ -543,3 +543,13 @@ def test_pp_ep_moe_matches_dense():
     assert abs(float(metrics["loss"]) - ref_loss) < 2e-3
     assert abs(float(metrics["aux_loss"]) - ref_aux) < 1e-3
     assert float(metrics["aux_loss"]) > 0
+
+
+def test_pp_ep_dense_model_refused():
+    """ep>1 under pp with a NON-MoE model has no expert dims to shard — the
+    axis would silently replicate every stage param; refuse loudly."""
+    cfg = DecoderConfig.tiny()
+    ctx = TrainContext.create(ShardingSpec(pp=2, ep=2, dp=2))
+    trainer = ctx.trainer(Decoder(cfg), optax.sgd(1e-2), n_microbatches=2)
+    with pytest.raises(ValueError, match="needs an MoE model"):
+        trainer.make_state(jax.random.key(0), _batch(cfg))

@@ -197,6 +197,11 @@ def main() -> int:
     run([py, "tools/bench_decode.py"],
         os.path.join(TOOLS, "bench_decode_r5.json"))
 
+    # 4b. long-context attention table (flash vs blockwise vs dense at
+    # S up to 16k) + a full-model S=8192 train step
+    run([py, "tools/bench_longcontext.py"],
+        os.path.join(TOOLS, "bench_longcontext_r5.json"))
+
     # 5. real-train_fn ASHA (BASELINE config 2 in miniature) on silicon
     run([py, "examples/resnet_asha.py"],
         os.path.join(TOOLS, "resnet_asha_r5.log"))
