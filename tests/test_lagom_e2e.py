@@ -245,7 +245,7 @@ def test_lagom_injects_train_context(tmp_env):
 
 
 @pytest.mark.slow
-def test_async_beats_bsp_wallclock():
+def test_async_beats_bsp_wallclock(tmp_env):
     """The reference's ONE published benchmark (DistributedML'20): async
     trial assignment completes a fixed random-search budget in 33-58% less
     wall-clock than synchronous BSP waves. Reproduced through the REAL

@@ -54,9 +54,13 @@ def run_async(num_trials: int, num_executors: int, dist: str, seed: int = 0):
 
     def train(hparams, reporter):
         d = duration_of(float(hparams["x"]))
-        durations.append(d)
         reporter.broadcast(float(hparams["x"]), step=0)
+        t0 = time.perf_counter()
         time.sleep(d)
+        # record the ACTUAL elapsed time, not the requested one: on a loaded
+        # host sleep overshoots, and the BSP baseline must pay the same
+        # overshoot or the comparison silently favors BSP
+        durations.append(time.perf_counter() - t0)
         return {"metric": float(hparams["x"])}
 
     t0 = time.perf_counter()
