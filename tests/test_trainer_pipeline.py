@@ -553,3 +553,29 @@ def test_pp_ep_dense_model_refused():
     trainer = ctx.trainer(Decoder(cfg), optax.sgd(1e-2), n_microbatches=2)
     with pytest.raises(ValueError, match="needs an MoE model"):
         trainer.make_state(jax.random.key(0), _batch(cfg))
+
+
+def test_pp_tp_ep_three_way_composition():
+    """pp x tp x ep on one mesh: attention heads tensor-sharded AND expert
+    FFNs expert-sharded inside each pipeline stage, training end-to-end."""
+    from maggy_tpu.models import MoEConfig, MoEDecoder
+
+    cfg = MoEConfig.tiny_moe()
+    batch = _batch(cfg, bsz=8, seq=16)
+    ctx = TrainContext.create(ShardingSpec(pp=2, tp=2, ep=2))
+    trainer = ctx.trainer(MoEDecoder(cfg), optax.adamw(1e-2), n_microbatches=2)
+    state = trainer.make_state(jax.random.key(1), batch)
+
+    specs = [
+        str(leaf.sharding.spec)
+        for _, leaf in jax.tree_util.tree_leaves_with_path(state.params)
+    ]
+    assert any("expert" in s for s in specs)
+    assert any("tensor" in s for s in specs)
+
+    losses = []
+    for _ in range(3):
+        state, m = trainer.step(state, trainer.shard_batch(batch))
+        losses.append(float(m["total_loss"]))
+    assert losses[-1] < losses[0]
+    assert float(m["aux_loss"]) > 0

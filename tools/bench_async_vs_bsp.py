@@ -57,10 +57,12 @@ def run_async(num_trials: int, num_executors: int, dist: str, seed: int = 0):
         reporter.broadcast(float(hparams["x"]), step=0)
         t0 = time.perf_counter()
         time.sleep(d)
-        # record the ACTUAL elapsed time, not the requested one: on a loaded
-        # host sleep overshoots, and the BSP baseline must pay the same
-        # overshoot or the comparison silently favors BSP
-        durations.append(time.perf_counter() - t0)
+        # record (start, ACTUAL elapsed): elapsed (not requested) so sleep
+        # overshoot on a loaded host taxes the BSP baseline too; start so
+        # BSP waves form in ASSIGNMENT order — completion order is roughly
+        # sorted ascending, and similar-duration waves would understate the
+        # BSP cost a real submission-ordered barrier pays
+        durations.append((t0, time.perf_counter() - t0))
         return {"metric": float(hparams["x"])}
 
     t0 = time.perf_counter()
@@ -79,7 +81,9 @@ def run_async(num_trials: int, num_executors: int, dist: str, seed: int = 0):
     )
     wall = time.perf_counter() - t0
     assert result["num_trials"] == num_trials, result
-    return wall, durations
+    # assignment order, not completion order (see comment in train)
+    durations.sort(key=lambda sd: sd[0])
+    return wall, [elapsed for _, elapsed in durations]
 
 
 def bsp_wall(durations, num_executors: int) -> float:
