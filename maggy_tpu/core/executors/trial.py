@@ -45,8 +45,15 @@ def trial_executor_fn(
 ) -> Callable[[], None]:
     # one lease-wide TrainContext shared by every trial this worker runs
     # (same devices -> same mesh; built only if the train_fn asks for it,
-    # so metric-only train_fns never touch jax)
+    # so metric-only train_fns never touch jax). ``devices`` may be a
+    # zero-arg callable (pod workers' env-spec lease, core/pod.py
+    # _worker_devices) resolved here — also lazily, same reason
     _ctx_cache: Dict[str, Any] = {}
+
+    def _lease_devices():
+        if "devices" not in _ctx_cache:
+            _ctx_cache["devices"] = devices() if callable(devices) else devices
+        return _ctx_cache["devices"]
 
     def _lease_ctx():
         if "ctx" not in _ctx_cache:
@@ -54,7 +61,9 @@ def trial_executor_fn(
 
             # honor a sharding preset configured on the experiment; default dp
             preset = getattr(config, "sharding", None) or "dp"
-            _ctx_cache["ctx"] = TrainContext.create(preset, devices=devices or None)
+            _ctx_cache["ctx"] = TrainContext.create(
+                preset, devices=_lease_devices() or None
+            )
         return _ctx_cache["ctx"]
 
     def _executor() -> None:
@@ -69,7 +78,13 @@ def trial_executor_fn(
             client.register(
                 meta={
                     "host": socket_mod.gethostname(),
-                    "devices": [str(d) for d in (devices or [])],
+                    # a callable lease is deliberately NOT resolved here —
+                    # registration must never touch the jax backend
+                    "devices": (
+                        [f"lease:{os.environ.get('MAGGY_TPU_WORKER_DEVICES', '?')}"]
+                        if callable(devices)
+                        else [str(d) for d in (devices or [])]
+                    ),
                 }
             )
             client.start_heartbeat(reporter)
@@ -98,21 +113,24 @@ def trial_executor_fn(
             **dict(getattr(config, "hparams", None) or {}),
             **{k: v for k, v in params.items() if k not in _CONTROL_KEYS},
         }
+        import inspect as _inspect
+
+        fn_params = _inspect.signature(train_fn).parameters
         available = {
             "hparams": hparams,
             "reporter": reporter,
             "model": getattr(config, "model", None),
             "dataset": getattr(config, "dataset", None),
-            "devices": devices,
+            # resolved only when asked for: a callable (env-spec) lease
+            # touches the jax backend, and metric-only train_fns never do
+            "devices": _lease_devices() if "devices" in fn_params else None,
             "trial_dir": trial_dir,
             "budget": params.get("budget"),
         }
         if resolve is not None:
             # experiment-kind hook: ablation swaps in per-trial model/dataset
             available = resolve(params, available)
-        import inspect as _inspect
-
-        if "ctx" in _inspect.signature(train_fn).parameters:
+        if "ctx" in fn_params:
             # lease-wide TrainContext, built only when the train_fn asks for
             # it so metric-only train_fns never touch jax
             available["ctx"] = _lease_ctx()

@@ -297,6 +297,56 @@ def run_worker(
     return {"role": "worker", "partition_id": pid}
 
 
+def _worker_devices():
+    """This trial worker's device lease. Default (None): span the host (one
+    worker per host). MAGGY_TPU_WORKER_DEVICES="0,1" leases a subset of
+    jax.local_devices() so several worker processes can share one host, each
+    trial training on its own sub-slice — the trial ↔ device-lease model the
+    local (thread) executors get from devices_per_trial, extended to pod
+    workers. CPU/GPU hosts only, or TPU processes already chip-partitioned
+    by the platform (TPU_VISIBLE_CHIPS etc.): a plain TPU runtime is
+    host-exclusive, so two unpartitioned processes cannot both initialize it.
+
+    Returns None (no lease) or a zero-arg CALLABLE resolving to the device
+    list — deferred so the worker never touches the jax backend before it
+    registers with the driver (a wedged accelerator transport would
+    otherwise hang it invisibly; executors keep jax lazy by design,
+    core/executors/trial.py)."""
+    spec = os.environ.get("MAGGY_TPU_WORKER_DEVICES", "").strip()
+    if not spec:
+        return None
+    # everything that needs no jax validates EAGERLY: a typo'd env var must
+    # fail at worker startup, not after the worker has registered and been
+    # handed a trial (which would strand that trial until worker_timeout —
+    # and loop forever under --respawn)
+    try:
+        idxs = [int(i) for i in spec.split(",")]
+    except ValueError as e:
+        raise RuntimeError(
+            f"MAGGY_TPU_WORKER_DEVICES={spec!r} is not a comma-separated "
+            f"list of local device indices: {e}"
+        ) from e
+    if len(set(idxs)) != len(idxs) or any(i < 0 for i in idxs):
+        raise RuntimeError(
+            f"MAGGY_TPU_WORKER_DEVICES={spec!r} must name distinct "
+            "non-negative indices — duplicate or negative leases would "
+            "silently alias devices instead of a disjoint sub-slice"
+        )
+
+    def resolve():
+        import jax
+
+        local = jax.local_devices()
+        if any(i >= len(local) for i in idxs):
+            raise RuntimeError(
+                f"MAGGY_TPU_WORKER_DEVICES={spec!r} indexes past this "
+                f"host's {len(local)} local device(s)"
+            )
+        return [local[i] for i in idxs]
+
+    return resolve
+
+
 def run_trial_worker(
     train_fn: Callable, config, host: str, port: int, secret: str,
     via_registry: bool = False,
@@ -329,7 +379,7 @@ def run_trial_worker(
         partition_id=pid,
         server_addr=(host, port),
         secret=secret,
-        devices=None,  # spans this host's devices
+        devices=_worker_devices(),
         resolve=resolve,
     )
     try:

@@ -241,3 +241,69 @@ def test_run_launcher_respawn_recovers_worker(tmp_path):
     assert any("'num_trials': 40" in l for l in result_lines), result_lines
     assert any("'role': 'trial_worker'" in l for l in result_lines), result_lines
     assert "respawning into the live experiment" in proc.stderr
+
+
+LEASE_WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # this worker actually touches jax (device lease): pin through force_cpu
+    # or the axon plugin's backend init can wedge even env-pinned processes
+    from maggy_tpu.util import pin_cpu_if_requested
+    pin_cpu_if_requested()
+    import jax
+
+    from maggy_tpu import Searchspace, experiment
+    from maggy_tpu.config import HyperparameterOptConfig
+
+    SERVED = [0]
+
+    def train(hparams, reporter, ctx, devices):
+        # the lease must be exactly the two devices named in
+        # MAGGY_TPU_WORKER_DEVICES, and the injected ctx's mesh spans it
+        assert len(devices) == 2, devices
+        assert len(list(ctx.mesh.devices.flat)) == 2
+        SERVED[0] += 1
+        reporter.broadcast(float(hparams["x"]), step=0)
+        return {{"metric": float(hparams["x"])}}
+
+    result = experiment.lagom(
+        train,
+        HyperparameterOptConfig(
+            num_trials=4,
+            optimizer="randomsearch",
+            searchspace=Searchspace(x=("DOUBLE", [0.0, 1.0])),
+            direction="max",
+            es_policy="none",
+            num_executors=2,
+            hb_interval=0.05,
+        ),
+    )
+    print("LEASE-WORKER-DONE served", SERVED[0], flush=True)
+    """
+)
+
+
+def test_pod_worker_device_lease(tmp_env, tmp_path):
+    """MAGGY_TPU_WORKER_DEVICES leases a sub-slice of the worker host's
+    devices to the remote trial executor — several workers can share one
+    host, each trial training on its own devices."""
+    result_holder = {}
+    t, driver = _start_driver(result_holder, trial_s=0.4, num_trials=30)
+
+    script = tmp_path / "worker.py"
+    script.write_text(LEASE_WORKER_SCRIPT.format(repo=REPO))
+    env = _worker_env(driver, tmp_path)
+    env["MAGGY_TPU_WORKER_DEVICES"] = "1,2"
+    worker = _spawn_worker(script, env)
+    out, _ = worker.communicate(timeout=120)
+    assert worker.returncode == 0, out[-2000:]
+    assert "LEASE-WORKER-DONE" in out
+    served = int(out.split("LEASE-WORKER-DONE served")[1].split()[0])
+    assert served > 0, out[-1500:]  # the lease asserts must have actually run
+
+    t.join(timeout=120)
+    assert "error" not in result_holder, result_holder.get("error")
+    assert result_holder["result"]["num_trials"] == 30
