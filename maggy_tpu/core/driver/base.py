@@ -67,6 +67,16 @@ class Driver(ABC):
         self.job_start: Optional[float] = None
         self.duration: Optional[float] = None
         self._log_fd = None
+        # telemetry: the driver's own recorder (server verb latencies land
+        # here) plus the latest per-worker snapshot shipped on heartbeats —
+        # folded into STATUS so monitors render a live throughput panel
+        from maggy_tpu import telemetry as _telemetry
+
+        self.telemetry = _telemetry.worker_telemetry(
+            "driver", self.exp_dir, role="driver", env=self.env
+        )
+        self.worker_telemetry: Dict[str, Any] = {}
+        self._traces_exported = False
 
     # ------------------------------------------------------------------ hooks
 
@@ -146,8 +156,16 @@ class Driver(ABC):
         if getattr(self, "_state", None) == "RUNNING":
             self._write_state("KILLED")
 
+    def note_worker_telemetry(self, msg: Dict[str, Any]) -> None:
+        """Record a heartbeat's telemetry snapshot (event-loop thread; a
+        single GIL-atomic dict store, like ``_touch``)."""
+        snap = msg.get("telemetry")
+        if snap:
+            self.worker_telemetry[str(msg.get("partition_id"))] = snap
+
     def init(self) -> None:
         self.server = self._make_server()
+        self.server.telemetry = self.telemetry
         self._register_msg_callbacks()
         # structured snapshot for monitors — registered for every driver kind
         # (the LOG verb ships lines; STATUS ships state — reference notebooks
@@ -266,8 +284,35 @@ class Driver(ABC):
                 self.experiment_done.set()
                 return
 
+    def _export_telemetry(self) -> None:
+        """Flush the driver recorder and assemble the merged Chrome trace +
+        TensorBoard mirror from every worker's JSONL (local workers flushed
+        theirs before FINAL; pod workers wrote to the shared root). Once per
+        experiment, best-effort — observability must never fail a run."""
+        if self._traces_exported:
+            return
+        self._traces_exported = True
+        from maggy_tpu import telemetry as _telemetry
+
+        if not _telemetry.enabled():
+            return
+        try:
+            self.telemetry.close()
+            from maggy_tpu.telemetry.export import (
+                export_chrome_trace,
+                mirror_to_tensorboard,
+            )
+
+            path = export_chrome_trace(self.env, self.exp_dir)
+            if path:
+                mirror_to_tensorboard(self.env, self.exp_dir)
+                self.log(f"telemetry: merged Chrome trace at {path}")
+        except Exception as e:  # noqa: BLE001 - exporters are best-effort
+            logger.warning("telemetry export failed: %s", e)
+
     def stop(self) -> None:
         self.experiment_done.set()
+        self._export_telemetry()
         if getattr(self, "_registered_driver", False):
             self.env.unregister_driver(self.app_id)
             self._registered_driver = False
@@ -330,7 +375,7 @@ class Driver(ABC):
 
     def _status(self) -> Dict[str, Any]:
         """Structured snapshot for the STATUS verb; drivers extend it."""
-        return {
+        out = {
             "kind": type(self).__name__,
             "state": getattr(self, "_state", "UNKNOWN"),
             "name": self.config.name,
@@ -339,3 +384,7 @@ class Driver(ABC):
             "num_executors": self.num_executors,
             "elapsed_s": time.time() - self.job_start if self.job_start else None,
         }
+        snaps = dict(self.worker_telemetry)  # event-loop-thread read; snapshot
+        if snaps:
+            out["telemetry"] = snaps
+        return out

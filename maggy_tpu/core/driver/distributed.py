@@ -132,6 +132,7 @@ class DistributedTrainingDriver(Driver):
 
     def _metric_callback(self, msg) -> Dict[str, Any]:
         self._touch(msg["partition_id"])
+        self.note_worker_telemetry(msg)
         self.server.enqueue(msg)
         return {"type": "STOP"} if self.abort.is_set() else {"type": "OK"}
 

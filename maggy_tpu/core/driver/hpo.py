@@ -196,6 +196,7 @@ class HyperparameterOptDriver(Driver):
 
     def _metric_callback(self, msg) -> Dict[str, Any]:
         self._touch(msg)
+        self.note_worker_telemetry(msg)
         self.server.enqueue(msg)
         if self.abort.is_set():
             # interrupt every broadcasting train_fn so aborted experiments do not

@@ -37,12 +37,19 @@ def dist_executor_fn(
     via_registry: bool = False,
 ) -> Callable[[], None]:
     def _executor() -> None:
+        from maggy_tpu import telemetry
+
         env = EnvSing.get_instance()
         exp_dir = env.experiment_dir(app_id, run_id)
         reporter = Reporter(
             log_file=os.path.join(exp_dir, f"executor_{partition_id}.log"),
             partition_id=partition_id,
         )
+        # per-worker recorder, ambient for this thread: Trainer.fit inside
+        # the train_fn records step metrics into it, heartbeats attach
+        # snapshots for the driver's STATUS panel and flush it to JSONL
+        tel = telemetry.worker_telemetry(partition_id, exp_dir, role="dist", env=env)
+        telemetry.set_current(tel)
         # pod hosts start simultaneously: the driver may need many seconds of
         # JAX bring-up before it listens, so retry well past Client's own 3
         # attempts
@@ -57,13 +64,16 @@ def dist_executor_fn(
             hb_interval=config.hb_interval,
             via_registry=via_registry,
         )
+        client.telemetry = tel
         try:
             client.register(meta={"host": socket_mod.gethostname()})
             client.start_heartbeat(reporter)
-            client.await_reservations()
+            with tel.span("await_reservations"):
+                client.await_reservations()
             exec_config = client.get_message("EXEC_CONFIG")
 
-            ctx = _build_context(exec_config, config)
+            with tel.span("build_context"):
+                ctx = _build_context(exec_config, config)
             reporter.reset(trial_id=f"dist_{partition_id}")
             worker_dir = os.path.join(exp_dir, f"worker_{partition_id}")
 
@@ -96,7 +106,7 @@ def dist_executor_fn(
                 # trial executor (reference trial_executor.py:93-103)
                 from maggy_tpu.reporter import capture_prints
 
-                with capture_prints(reporter):
+                with tel.span("train_fn", partition=partition_id), capture_prints(reporter):
                     retval = train_fn(**kwargs)
                 if retval is not None:
                     # per-worker dir: concurrent workers must not clobber
@@ -112,12 +122,15 @@ def dist_executor_fn(
             except Exception as e:  # noqa: BLE001
                 error = f"{type(e).__name__}: {e}"
                 reporter.log(f"Distributed worker {partition_id} failed:\n{traceback.format_exc()}")
+            tel.flush()  # events are durable before FINAL ships
             client.finalize_metric(
                 f"dist_{partition_id}", metric, outputs=util._jsonify(outputs), error=error
             )
         finally:
             client.stop()
             reporter.close()
+            telemetry.set_current(None)
+            tel.close()
 
     def _build_context(exec_config, config):
         import jax

@@ -67,12 +67,20 @@ def trial_executor_fn(
         return _ctx_cache["ctx"]
 
     def _executor() -> None:
+        from maggy_tpu import telemetry
+
         env = EnvSing.get_instance()
         exp_dir = env.experiment_dir(app_id, run_id)
         log_file = os.path.join(exp_dir, f"executor_{partition_id}.log")
         reporter = Reporter(log_file=log_file, partition_id=partition_id)
+        # per-worker recorder, ambient for this thread: Trainer.fit and the
+        # Checkpointer inside the train_fn record into it; the heartbeat
+        # attaches snapshots and flushes it to the JSONL sink every beat
+        tel = telemetry.worker_telemetry(partition_id, exp_dir, role="trial", env=env)
+        telemetry.set_current(tel)
         client = rpc.Client(
-            server_addr, partition_id, secret, hb_interval=config.hb_interval
+            server_addr, partition_id, secret, hb_interval=config.hb_interval,
+            telemetry=tel,
         )
         try:
             client.register(
@@ -96,6 +104,8 @@ def trial_executor_fn(
         finally:
             client.stop()
             reporter.close()
+            telemetry.set_current(None)
+            tel.close()
 
     def _run_trial(reply: Dict[str, Any], client: rpc.Client, reporter: Reporter, env) -> None:
         from maggy_tpu import tensorboard as tb
@@ -136,6 +146,9 @@ def trial_executor_fn(
             available["ctx"] = _lease_ctx()
         kwargs = util.inject_kwargs(train_fn, available)
 
+        from maggy_tpu import telemetry
+
+        tel = telemetry.get()
         metric: Optional[float] = None
         outputs: Dict[str, Any] = {}
         error: Optional[str] = None
@@ -143,7 +156,7 @@ def trial_executor_fn(
         try:
             # train_fn prints ship to the driver with the heartbeat logs
             # (reference trial_executor.py:93-103)
-            with capture_prints(reporter):
+            with tel.span("trial", trial_id=trial_id), capture_prints(reporter):
                 retval = train_fn(**kwargs)
             metric = util.handle_return_val(
                 retval, trial_dir, config.optimization_key
@@ -159,6 +172,8 @@ def trial_executor_fn(
             reporter.log(f"Trial {trial_id} failed:\n{traceback.format_exc()}")
 
         tb._unregister()
+        tel.count("trials_errored" if error else "trials_done")
+        tel.flush()  # trial boundary: events are durable before FINAL ships
         client.finalize_metric(
             trial_id,
             metric,

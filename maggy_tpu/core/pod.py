@@ -64,19 +64,25 @@ def initialize_data_plane(
         )
     import jax
 
+    from maggy_tpu import telemetry
+
+    tel = telemetry.get()
     # multi-process CPU collectives need the gloo transport; harmless when the
     # resolved platform is TPU (the knob only affects the CPU backend), and the
     # platform cannot be resolved before initialize without starting a backend
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
-        coordinator, num_processes=num_processes, process_id=process_id
-    )
-    # Create the backend NOW: backend creation runs a global device-exchange
-    # barrier across all processes, so every rank must reach it at the same
-    # program point. Deferring it lets rank roles diverge — e.g. the driver
-    # touching jax before its RPC server is up while workers wait on that
-    # server before touching jax — a circular wait only broken by a timeout.
-    jax.devices()
+    t0 = time.perf_counter()
+    with tel.span("data_plane_init", coordinator=coordinator, rank=process_id):
+        jax.distributed.initialize(
+            coordinator, num_processes=num_processes, process_id=process_id
+        )
+        # Create the backend NOW: backend creation runs a global device-exchange
+        # barrier across all processes, so every rank must reach it at the same
+        # program point. Deferring it lets rank roles diverge — e.g. the driver
+        # touching jax before its RPC server is up while workers wait on that
+        # server before touching jax — a circular wait only broken by a timeout.
+        jax.devices()
+    tel.gauge("data_plane_init_ms", (time.perf_counter() - t0) * 1e3)
     return True
 
 
@@ -225,11 +231,18 @@ def _connect_with_deadline(
     from maggy_tpu.core import rpc
     from maggy_tpu.exceptions import RpcError
 
+    from maggy_tpu import telemetry
+
+    start = time.perf_counter()
     deadline = time.time() + deadline_s
     delay = 0.2
     while True:
         try:
-            return rpc.Client((host, port), pid, secret, hb_interval)
+            client = rpc.Client((host, port), pid, secret, hb_interval)
+            telemetry.get().gauge(
+                "driver_connect_ms", (time.perf_counter() - start) * 1e3
+            )
+            return client
         except RpcError as e:
             if time.time() > deadline:
                 hint = ""
@@ -390,9 +403,12 @@ def run_trial_worker(
         # propagate: the process exits nonzero and a supervisor
         # (maggy_tpu.run --respawn) can put the capacity back — swallowing
         # here would read as a clean exit and defeat the respawn.
+        import sys
+
         print(
             f"[maggy_tpu pod worker {pid}] driver unreachable ({e}); exiting "
-            "for the supervisor to respawn"
+            "for the supervisor to respawn",
+            file=sys.stderr,
         )
         raise
     return {"role": "trial_worker", "partition_id": pid}

@@ -144,6 +144,9 @@ class Server:
     def __init__(self, num_executors: int, secret: Optional[str] = None):
         self.reservations = Reservations(num_executors)
         self.secret = secret or secrets_mod.token_hex(16)
+        # driver-owned telemetry recorder (set by Driver.init); _dispatch
+        # records per-verb handler counts/latencies into it
+        self.telemetry = None
         self.message_queue: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.callbacks: Dict[str, Callable[[Dict[str, Any]], Dict[str, Any]]] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -251,10 +254,16 @@ class Server:
         handler = self.callbacks.get(verb)
         if handler is None:
             return {"type": "ERR", "error": f"unknown verb {verb!r}"}
+        tel = self.telemetry
+        t0 = time.perf_counter() if tel is not None else 0.0
         try:
             reply = handler(msg)
         except Exception as e:  # handler bugs must not kill the socket loop
+            if tel is not None:
+                tel.rpc(f"srv.{verb}", (time.perf_counter() - t0) * 1e3, ok=False)
             return {"type": "ERR", "error": f"{type(e).__name__}: {e}"}
+        if tel is not None:
+            tel.rpc(f"srv.{verb}", (time.perf_counter() - t0) * 1e3)
         return reply if reply is not None else {"type": "OK"}
 
     # ------------------------------------------------------------------ helpers
@@ -298,11 +307,17 @@ class Client:
         partition_id: int,
         secret: str,
         hb_interval: float = 1.0,
+        telemetry=None,
     ):
         self.server_addr = tuple(server_addr)
         self.partition_id = partition_id
         self.secret = secret
         self.hb_interval = hb_interval
+        # worker recorder: per-verb client latencies + heartbeat RTT land
+        # here, and each beat attaches its snapshot for the driver's STATUS
+        # aggregation. An explicit reference (not the thread-ambient getter)
+        # because the heartbeat runs on its own thread.
+        self.telemetry = telemetry
         # one nonce per client instance: lets the server tell a retried REG
         # (same nonce) from a restarted worker (new nonce)
         self.attempt_id = secrets_mod.token_hex(8)
@@ -327,10 +342,13 @@ class Client:
     def _request(self, msg: Dict[str, Any], heartbeat: bool = False) -> Dict[str, Any]:
         """Send one frame and read the reply, reconnecting up to MAX_RETRIES
         (reference rpc.py:660-688)."""
+        verb = msg.get("type", "?")
         msg = {**msg, "secret": self.secret, "partition_id": self.partition_id}
         last_err: Optional[Exception] = None
+        tel = self.telemetry
         for attempt in range(constants.RPC_MAX_RETRIES):
             try:
+                t0 = time.perf_counter()
                 if heartbeat:
                     send_frame(self._hb_sock, msg)
                     reply = recv_frame(self._hb_sock)
@@ -338,12 +356,16 @@ class Client:
                     with self._main_lock:
                         send_frame(self._main_sock, msg)
                         reply = recv_frame(self._main_sock)
+                if tel is not None:
+                    tel.rpc(verb, (time.perf_counter() - t0) * 1e3)
                 if reply.get("type") == "ERR":
                     raise RpcError(f"Driver rejected message: {reply.get('error')}")
                 return reply
             except (OSError, RpcError) as e:
                 if isinstance(e, RpcError) and "rejected" in str(e):
                     raise
+                if tel is not None:
+                    tel.rpc(verb, None, ok=False)
                 last_err = e
                 time.sleep(0.2 * (attempt + 1))
                 try:
@@ -445,19 +467,29 @@ class Client:
 
     def _send_beat(self, reporter) -> None:
         trial_id, metric, step, logs = reporter.get_data()
+        tel = self.telemetry
+        beat = {
+            "type": "METRIC",
+            "trial_id": trial_id,
+            "metric": metric,
+            "step": step,
+            "logs": logs,
+        }
+        if tel is not None and tel.active:
+            snap = tel.snapshot()
+            if snap:
+                beat["telemetry"] = snap
+        t0 = time.perf_counter()
         try:
-            reply = self._request(
-                {
-                    "type": "METRIC",
-                    "trial_id": trial_id,
-                    "metric": metric,
-                    "step": step,
-                    "logs": logs,
-                },
-                heartbeat=True,
-            )
+            reply = self._request(beat, heartbeat=True)
         except RpcError:
             return  # skip this beat; next one reconnects
+        if tel is not None:
+            # driver round-trip as seen by the worker: control-plane health
+            tel.gauge("heartbeat_rtt_ms", (time.perf_counter() - t0) * 1e3)
+            # heartbeat cadence doubles as the durable-flush cadence: events
+            # reach the JSONL sink every beat, so a crash loses <=1 interval
+            tel.flush()
         if reply.get("type") == "STOP":
             reporter.early_stop()
 

@@ -15,6 +15,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from maggy_tpu.parallel.sharding import logical_partitioning
+
 from maggy_tpu.models.transformer import _dense
 from maggy_tpu.ops.attention import blockwise_attention
 
@@ -73,7 +75,7 @@ class BertLayer(nn.Module):
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_partitioning(
+            kernel_init=logical_partitioning(
                 nn.initializers.normal(0.02), ("heads", None, "embed")
             ),
             name="wo",
@@ -102,7 +104,7 @@ class Bert(nn.Module):
             attention_mask = jnp.ones_like(tokens)
         embed = self.param(
             "embedding",
-            nn.with_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
+            logical_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.d_model),
             cfg.param_dtype,
         )
@@ -110,7 +112,7 @@ class Bert(nn.Module):
         if "position_embeddings" not in cfg.ablated:
             pos = self.param(
                 "position_embedding",
-                nn.with_partitioning(nn.initializers.normal(0.02), (None, "embed")),
+                logical_partitioning(nn.initializers.normal(0.02), (None, "embed")),
                 (cfg.max_seq_len, cfg.d_model),
                 cfg.param_dtype,
             )

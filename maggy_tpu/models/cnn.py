@@ -14,6 +14,8 @@ from typing import Any, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from maggy_tpu.parallel.sharding import logical_partitioning
+
 
 def _norm(cfg, channels: int, name: str):
     return nn.GroupNorm(
@@ -50,7 +52,7 @@ def _conv(features, kernel, strides, cfg, name):
         use_bias=False,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
-        kernel_init=nn.with_partitioning(
+        kernel_init=logical_partitioning(
             nn.initializers.he_normal(),
             ("conv_spatial", "conv_spatial", "conv_in", "conv_out"),
         ),
@@ -108,7 +110,7 @@ class ResNet(nn.Module):
             cfg.num_classes,
             dtype=jnp.float32,
             param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_partitioning(
+            kernel_init=logical_partitioning(
                 nn.initializers.zeros_init(), ("embed", None)
             ),
             name="head",

@@ -7,6 +7,8 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from maggy_tpu.parallel.sharding import logical_partitioning
+
 
 class MLP(nn.Module):
     features: Sequence[int] = (128, 64)
@@ -21,7 +23,7 @@ class MLP(nn.Module):
             x = nn.Dense(
                 width,
                 dtype=self.dtype,
-                kernel_init=nn.with_partitioning(
+                kernel_init=logical_partitioning(
                     nn.initializers.he_normal(), ("embed", "mlp")
                 ),
                 name=f"dense_{i}",
@@ -32,7 +34,7 @@ class MLP(nn.Module):
         return nn.Dense(
             self.num_classes,
             dtype=self.dtype,
-            kernel_init=nn.with_partitioning(
+            kernel_init=logical_partitioning(
                 nn.initializers.he_normal(), ("mlp", None)
             ),
             name="head",

@@ -206,9 +206,14 @@ class DecoderConfig:
 
 def _partitioned(init, logical_axes, cfg):
     # getattr: _dense/RMSNorm are shared by model configs (Bert, MoE, ...)
-    # that don't carry the pipeline-only partition_params switch
+    # that don't carry the pipeline-only partition_params switch.
+    # logical_partitioning (not nn.with_partitioning): the names are LOGICAL
+    # axes the trainer's rule tables resolve — flax must never apply them as
+    # a raw sharding constraint (parallel/sharding.py LogicalPartitioned)
     if getattr(cfg, "partition_params", True):
-        return nn.with_partitioning(init, logical_axes)
+        from maggy_tpu.parallel.sharding import logical_partitioning
+
+        return logical_partitioning(init, logical_axes)
     return init
 
 

@@ -20,9 +20,30 @@ import sys
 import time
 
 
+def _record_addr(rec):
+    """Connectable (host, port) for a registry record: pod records advertise
+    a cross-host hostname, local records mean loopback."""
+    host = rec["host"] if rec.get("scope", "pod") == "pod" else "127.0.0.1"
+    return host, int(rec["port"])
+
+
+def _driver_alive(host, port, timeout: float = 0.75) -> bool:
+    """True when something accepts TCP connections at host:port."""
+    import socket
+
+    try:
+        socket.create_connection((host, port), timeout=timeout).close()
+        return True
+    except OSError:
+        return False
+
+
 def resolve_target(env, app_id=None):
     """(host, port, secret) from the driver registry. ``app_id=None`` picks
-    the newest record. Raises LookupError when nothing is registered."""
+    the newest record whose driver still accepts connections — a SIGKILLed
+    driver cannot unregister, so stale records are skipped AND pruned (best
+    effort) instead of attaching to a dead address. Raises LookupError when
+    nothing live is registered."""
     if app_id:
         rec = env.lookup_driver(app_id)
         if rec is None:
@@ -33,27 +54,86 @@ def resolve_target(env, app_id=None):
         recs = env.list_drivers()
         if not recs:
             raise LookupError(f"No drivers registered under {env.root}")
-        rec = recs[0]
-    host = rec["host"] if rec.get("scope", "pod") == "pod" else "127.0.0.1"
+        rec = None
+        pruned = 0
+        for candidate in recs:  # newest first
+            host, port = _record_addr(candidate)
+            if _driver_alive(host, port):
+                rec = candidate
+                break
+            pruned += 1
+            stale_app = candidate.get("app_id")
+            if stale_app:
+                print(
+                    f"[monitor] pruning stale registry record for {stale_app} "
+                    f"({host}:{port} refuses connections)",
+                    file=sys.stderr,
+                )
+                env.unregister_driver(stale_app)
+        if rec is None:
+            raise LookupError(
+                f"No live drivers under {env.root} "
+                f"({pruned} stale record(s) pruned)"
+            )
+    host, port = _record_addr(rec)
     # address-only records (MAGGY_TPU_REGISTRY_NO_SECRET=1 drivers) rely on
     # the secret arriving out-of-band via env
     secret = rec.get("secret") or os.environ.get("MAGGY_TPU_SECRET", "")
-    return host, int(rec["port"]), secret
+    return host, port, secret
+
+
+def _pid_key(kv):  # JSON stringifies pids; sort numerically
+    try:
+        return (0, int(kv[0]))
+    except ValueError:
+        return (1, kv[0])
 
 
 def _heartbeat_line(seen: dict) -> str:
     """'last heartbeat: w0:1.2s w1:0.4s ...' — shared by the HPO and
     distributed dashboard branches."""
-
-    def pid_key(kv):  # JSON stringifies pids; sort numerically
-        try:
-            return (0, int(kv[0]))
-        except ValueError:
-            return (1, kv[0])
-
     return "last heartbeat: " + "  ".join(
-        f"w{pid}:{age}s" for pid, age in sorted(seen.items(), key=pid_key)
+        f"w{pid}:{age}s" for pid, age in sorted(seen.items(), key=_pid_key)
     )
+
+
+def _telemetry_lines(status: dict, width: int) -> list:
+    """Throughput/step-time panel from the per-worker telemetry snapshots the
+    driver folds into STATUS (heartbeat-attached recorder state)."""
+    snaps = status.get("telemetry") or {}
+    if not snaps:
+        return []
+    lines = []
+    gauges = {pid: (snap.get("gauges") or {}) for pid, snap in snaps.items()}
+    tok_total = sum(
+        g["tokens_per_sec"] for g in gauges.values() if "tokens_per_sec" in g
+    )
+    step_times = [g["step_time_ms"] for g in gauges.values() if "step_time_ms" in g]
+    agg = []
+    if tok_total:
+        agg.append(f"throughput {tok_total:,.0f} tok/s")
+    if step_times:
+        agg.append(f"mean step {sum(step_times) / len(step_times):.1f}ms")
+    lines.append(("-- telemetry --" + ("  " + "  ".join(agg) if agg else ""))[:width])
+    for pid, snap in sorted(snaps.items(), key=_pid_key):
+        g = snap.get("gauges") or {}
+        parts = []
+        if "step_time_ms" in g:
+            parts.append(f"{g['step_time_ms']:.1f}ms/step")
+        if "steps_per_sec" in g:
+            parts.append(f"{g['steps_per_sec']:.2f}st/s")
+        if "tokens_per_sec" in g:
+            parts.append(f"{g['tokens_per_sec']:,.0f}tok/s")
+        if "mfu_est" in g:
+            parts.append(f"mfu {100 * g['mfu_est']:.1f}%")
+        if "compile_time_ms" in g:
+            parts.append(f"compile {g['compile_time_ms'] / 1e3:.1f}s")
+        if "heartbeat_rtt_ms" in g:
+            parts.append(f"hb {g['heartbeat_rtt_ms']:.1f}ms")
+        if not parts:
+            continue
+        lines.append(f"w{pid}: " + "  ".join(parts)[: width - 5])
+    return lines
 
 
 def render_status(status: dict, width: int = 78) -> str:
@@ -91,6 +171,7 @@ def render_status(status: dict, width: int = 78) -> str:
         seen = status.get("last_seen") or {}
         if seen:  # pod-mode HPO: remote trial workers' heartbeat ages
             lines.append(_heartbeat_line(seen))
+        lines.extend(_telemetry_lines(status, width))
         tail = status.get("controller_log") or []
         if tail:
             lines.append(f"-- {status.get('controller', 'controller')} decisions --")
@@ -108,6 +189,7 @@ def render_status(status: dict, width: int = 78) -> str:
         seen = status.get("last_seen") or {}
         if seen:
             lines.append(_heartbeat_line(seen))
+        lines.extend(_telemetry_lines(status, width))
     return "\n".join(lines)
 
 

@@ -81,6 +81,45 @@ def named_sharding(mesh, logical_axes: Sequence[Optional[str]], rules=DEFAULT_RU
     return NamedSharding(mesh, partition_spec(logical_axes, rules))
 
 
+def _resolve_axis(name, table, mesh_axes):
+    """One logical name -> mesh axis assignment. Names already naming mesh
+    axes pass through unchanged (boxes rewritten by
+    :func:`resolve_boxed_names` re-enter here idempotently); unknown names
+    replicate."""
+    if name is None:
+        return None
+    if name in table:
+        return table[name]
+    if name in mesh_axes:
+        return name
+    if isinstance(name, (tuple, list)) and all(a in mesh_axes for a in name):
+        return tuple(name)
+    return None
+
+
+def _divisible_axes(names, shape, mesh, rules, warn_context=None):
+    """Mesh axes for a leaf's dims with the divisibility fallback: axes whose
+    extent does not divide the dim replicate (a layout downgrade, never a
+    crash) — e.g. 4 attention heads on a tensor=8 mesh."""
+    import logging
+
+    table = dict(rules)
+    mesh_axes = set(dict(mesh.shape))
+    axes = []
+    for i, name in enumerate(names):
+        axis = _resolve_axis(name, table, mesh_axes)
+        ext = mesh_extent(mesh, axis)
+        if ext > 1 and shape[i] % ext != 0:
+            logging.getLogger(__name__).warning(
+                "Axis %d of param (shape %s, logical %s) is not divisible by "
+                "mesh axis %r (size %d); replicating that dimension.",
+                i, shape, names, axis, ext,
+            )
+            axis = None
+        axes.append(axis)
+    return axes
+
+
 def params_shardings(mesh, abstract_params, rules=DEFAULT_RULES):
     """Map a pytree of (possibly flax-partitioned) abstract leaves to NamedShardings.
 
@@ -90,8 +129,6 @@ def params_shardings(mesh, abstract_params, rules=DEFAULT_RULES):
     tensor=8 mesh) — a layout downgrade, never a crash. This is what makes user
     models "obliviously" shardable: annotate once, run under any mesh.
     """
-    import logging
-
     import flax.linen as nn
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
@@ -99,22 +136,58 @@ def params_shardings(mesh, abstract_params, rules=DEFAULT_RULES):
     def leaf_sharding(leaf):
         if not isinstance(leaf, nn.Partitioned):
             return NamedSharding(mesh, PartitionSpec())
-        shape = leaf.value.shape
-        axes = list(logical_to_mesh_axes(leaf.names, rules))
-        for i, axis in enumerate(axes):
-            ext = mesh_extent(mesh, axis)
-            if ext > 1 and shape[i] % ext != 0:
-                logging.getLogger(__name__).warning(
-                    "Axis %d of param (shape %s, logical %s) is not divisible by "
-                    "mesh axis %r (size %d); replicating that dimension.",
-                    i, shape, leaf.names, axis, ext,
-                )
-                axes[i] = None
+        axes = _divisible_axes(leaf.names, leaf.value.shape, mesh, rules)
         return NamedSharding(mesh, PartitionSpec(*axes))
 
     return jax.tree.map(
         leaf_sharding, abstract_params, is_leaf=lambda x: isinstance(x, nn.Partitioned)
     )
+
+
+_logical_partitioned_cls = None
+
+
+def _logical_partitioned_class():
+    global _logical_partitioned_cls
+    if _logical_partitioned_cls is None:
+        import flax.linen as nn
+        from flax import struct
+
+        @struct.dataclass
+        class LogicalPartitioned(nn.Partitioned):
+            """``nn.Partitioned`` whose unboxing NEVER self-constrains.
+
+            The names here are LOGICAL axes ('embed', 'vocab', ...), resolved
+            to mesh axes only by this module's rule tables. Stock flax applies
+            the names directly as a ``with_sharding_constraint`` whenever an
+            ambient mesh is active (``Partitioned.unbox``) — and raw logical
+            names are not mesh axes, so every ``model.init``/``apply`` under
+            ``with mesh:`` would be rejected by jax. Placement in this
+            framework is decided once, by ``Trainer.make_state``'s
+            out_shardings (from :func:`params_shardings`), and GSPMD
+            propagates it — the boxes are pure metadata carriers.
+            """
+
+            def unbox(self, apply_constraint=True):
+                return self.value
+
+        _logical_partitioned_cls = LogicalPartitioned
+    return _logical_partitioned_cls
+
+
+def logical_partitioning(fn, names):
+    """Like ``nn.with_partitioning(fn, names)``, but producing
+    :class:`LogicalPartitioned` boxes (metadata-only, no unbox-time
+    constraint). Every framework model annotates through this."""
+    import functools
+
+    cls = _logical_partitioned_class()
+
+    @functools.wraps(fn)
+    def init(*args, **kwargs):
+        return cls(fn(*args, **kwargs), names)
+
+    return init
 
 
 def unbox(tree):

@@ -1,0 +1,49 @@
+"""Unified telemetry: spans, step metrics, and durable trace export.
+
+The reference framework's only observability is log shipping plus one scalar
+metric per heartbeat (SURVEY §2.4 LOG/METRIC verbs). This package adds the
+structured layer every tier threads through:
+
+* :mod:`maggy_tpu.telemetry.recorder` — a process-local :class:`Telemetry`
+  recorder with ``span(name)`` context managers and typed counters/gauges,
+  buffered lock-free per worker. ``MAGGY_TPU_TELEMETRY=0`` swaps in a no-op
+  recorder so the hot path carries zero instrumentation cost.
+* :mod:`maggy_tpu.telemetry.sink` — a JSONL sink on the env storage seam, so
+  records land under ``<exp_dir>/telemetry/worker_<pid>.jsonl`` identically on
+  a local disk or ``gs://``.
+* :mod:`maggy_tpu.telemetry.export` — merges every worker's JSONL into one
+  Chrome-trace (Perfetto-loadable) JSON on the shared wall-clock base, and
+  mirrors gauge series into TensorBoard scalars via the tensorboard.py seam.
+
+Wiring: executors build a worker recorder (:func:`worker_telemetry`), install
+it as the thread-ambient recorder (``Trainer.fit`` and ``Checkpointer`` pick
+it up via :func:`get`), and hand it to the RPC client so per-verb latencies
+and heartbeat RTTs record too; every heartbeat attaches a snapshot that the
+driver folds into STATUS for the live monitor panel.
+"""
+
+from __future__ import annotations
+
+from maggy_tpu.telemetry.recorder import (  # noqa: F401
+    NULL,
+    NullTelemetry,
+    Telemetry,
+    current,
+    enabled,
+    get,
+    set_current,
+)
+from maggy_tpu.telemetry.sink import JsonlSink, telemetry_dir, worker_telemetry  # noqa: F401
+
+__all__ = [
+    "Telemetry",
+    "NullTelemetry",
+    "NULL",
+    "enabled",
+    "get",
+    "set_current",
+    "current",
+    "JsonlSink",
+    "telemetry_dir",
+    "worker_telemetry",
+]

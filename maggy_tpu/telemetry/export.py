@@ -1,0 +1,158 @@
+"""Telemetry exporters: merged Chrome trace + TensorBoard scalar mirror.
+
+``export_chrome_trace`` folds every worker's JSONL under
+``<exp_dir>/telemetry/`` into one Perfetto-loadable ``trace.json``: spans
+become complete (``ph="X"``) events and gauges become counter (``ph="C"``)
+events, all on the shared wall-clock microsecond base the recorder stamps, so
+spans from different workers/hosts interleave correctly on one timeline.
+
+``mirror_to_tensorboard`` replays each worker's gauge series through the
+existing :mod:`maggy_tpu.tensorboard` seam (``events.jsonl`` always, real TF
+event files when the tensorboard package is importable).
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+from typing import Any, Dict, List, Optional
+
+from maggy_tpu.telemetry.sink import telemetry_dir
+
+
+def _worker_pid(worker: Any, assigned: Dict[str, int]) -> int:
+    """Chrome-trace pid for a worker id: numeric ids map directly; named
+    workers (driver, standalone) get stable slots from 1000 up."""
+    s = str(worker)
+    if s.lstrip("-").isdigit():
+        return int(s)
+    if s not in assigned:
+        assigned[s] = 1000 + len(assigned)
+    return assigned[s]
+
+
+def load_records(env, exp_dir: str) -> Dict[str, List[Dict[str, Any]]]:
+    """All telemetry JSONL records under ``exp_dir``, keyed by file stem.
+    Unparseable lines are skipped — a crashed worker may leave a torn tail."""
+    tdir = telemetry_dir(exp_dir)
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    try:
+        names = [n for n in env.listdir(tdir) if n.endswith(".jsonl")]
+    except OSError:
+        return out
+    for name in names:
+        records = []
+        try:
+            with env.open_file(posixpath.join(tdir, name), "r") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+        if records:
+            out[name[: -len(".jsonl")]] = records
+    return out
+
+
+def export_chrome_trace(env, exp_dir: str, out_name: str = "trace.json") -> Optional[str]:
+    """Merge all worker JSONLs into ``<exp_dir>/telemetry/trace.json``.
+    Returns the written path, or None when there is nothing to export."""
+    by_worker = load_records(env, exp_dir)
+    if not by_worker:
+        return None
+    assigned: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    seen_pids: Dict[int, str] = {}
+    for stem, records in sorted(by_worker.items()):
+        for rec in records:
+            worker = rec.get("worker", stem)
+            pid = _worker_pid(worker, assigned)
+            seen_pids.setdefault(pid, str(worker))
+            ts = rec.get("ts")
+            if ts is None:
+                continue
+            kind = rec.get("kind")
+            if kind == "span":
+                events.append(
+                    {
+                        "name": rec.get("name", "?"),
+                        "cat": "span",
+                        "ph": "X",
+                        "ts": int(float(ts) * 1e6),
+                        "dur": max(1, int(float(rec.get("dur_ms", 0.0)) * 1e3)),
+                        "pid": pid,
+                        "tid": int(rec.get("tid", 0)),
+                        "args": rec.get("attrs") or {},
+                    }
+                )
+            elif kind == "gauge":
+                events.append(
+                    {
+                        "name": rec.get("name", "?"),
+                        "cat": "gauge",
+                        "ph": "C",
+                        "ts": int(float(ts) * 1e6),
+                        "pid": pid,
+                        "tid": 0,
+                        "args": {rec.get("name", "value"): rec.get("value")},
+                    }
+                )
+    if not events:
+        return None
+    events.sort(key=lambda e: e["ts"])
+    # process-name metadata first (ts 0 keeps them ahead after the sort above)
+    meta = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "ts": 0,
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"worker {label}"},
+        }
+        for pid, label in sorted(seen_pids.items())
+    ]
+    path = posixpath.join(telemetry_dir(exp_dir), out_name)
+    env.dump(
+        json.dumps(
+            {"traceEvents": meta + events, "displayTimeUnit": "ms"},
+            separators=(",", ":"),
+        ),
+        path,
+    )
+    return path
+
+
+def mirror_to_tensorboard(env, exp_dir: str) -> int:
+    """Replay gauge series as TensorBoard scalars under
+    ``<exp_dir>/telemetry/tb/<worker>/`` via the tensorboard.py seam.
+    Returns the number of scalars written (0 when there is nothing)."""
+    from maggy_tpu import tensorboard as tb
+
+    by_worker = load_records(env, exp_dir)
+    written = 0
+    for stem, records in sorted(by_worker.items()):
+        gauges = [r for r in records if r.get("kind") == "gauge"]
+        if not gauges:
+            continue
+        logdir = posixpath.join(telemetry_dir(exp_dir), "tb", stem)
+        tb._register(logdir)
+        try:
+            steps: Dict[str, int] = {}
+            for rec in gauges:
+                tag = str(rec.get("name", "value"))
+                step = steps.get(tag, 0)
+                steps[tag] = step + 1
+                try:
+                    tb.scalar(f"telemetry/{tag}", float(rec.get("value", 0.0)), step)
+                    written += 1
+                except (TypeError, ValueError, OSError):
+                    continue
+        finally:
+            tb._unregister()
+    return written

@@ -1,0 +1,54 @@
+"""FLOPs/MFU estimation for telemetry gauges.
+
+Same model as bench.py's headline metric: training FLOPs/token ≈ 6·params
+(fwd+bwd matmul estimate), peak chip FLOPs detected loosely from the device
+kind (v5p 459 TFLOPs bf16, else v5e 197). Non-TPU devices return None — an
+"MFU" against an unknown peak would be noise, so the gauge is simply omitted
+there (CPU test meshes, GPU hosts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def param_count(tree) -> int:
+    """Total parameter count of a (possibly nn.Partitioned-boxed) param tree."""
+    import jax
+
+    try:
+        import flax.linen as nn
+
+        boxed = (nn.Partitioned,)
+    except Exception:  # flax absent: plain arrays only
+        boxed = ()
+
+    total = 0
+    for leaf in jax.tree.leaves(tree, is_leaf=lambda x: isinstance(x, boxed) if boxed else None):
+        val = leaf.value if boxed and isinstance(leaf, boxed) else leaf
+        total += getattr(val, "size", 0)
+    return int(total)
+
+
+def flops_per_token(n_params: int) -> float:
+    """Training (fwd+bwd) matmul FLOPs per token, the standard 6N estimate."""
+    return 6.0 * float(n_params)
+
+
+def device_peak_flops(device: Any) -> Optional[float]:
+    """Peak bf16 FLOPs/s for a device, or None when unknown (CPU/GPU)."""
+    if getattr(device, "platform", None) != "tpu":
+        return None
+    kind = str(device).lower()
+    return 459e12 if ("v5p" in kind or "p5" in kind) else 197e12
+
+
+def estimate_mfu(tok_per_sec: float, n_params: int, devices) -> Optional[float]:
+    """Achieved/peak FLOPs fraction for a whole device set, or None off-TPU."""
+    if not devices or tok_per_sec <= 0 or n_params <= 0:
+        return None
+    peak = device_peak_flops(devices[0])
+    if peak is None:
+        return None
+    achieved = tok_per_sec * flops_per_token(n_params)
+    return achieved / (peak * len(devices))

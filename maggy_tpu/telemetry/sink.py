@@ -1,0 +1,85 @@
+"""JSONL telemetry sink on the env storage seam.
+
+Records land under ``<exp_dir>/telemetry/worker_<pid>.jsonl`` — identically on
+a local disk and on ``gs://`` (via :class:`maggy_tpu.core.env.gcs.GcsEnv`).
+Local roots append per flush; remote object stores cannot append, so the sink
+buffers the full record history and republishes the whole object each flush
+(bounded, same trade the Reporter's remote log makes).
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+from typing import Any, Dict, List
+
+# remote (object-store) sinks cap the republished history; oldest records are
+# dropped with an explicit truncation marker, mirroring Reporter's remote log
+_REMOTE_MAX_RECORDS = 50_000
+
+
+def telemetry_dir(exp_dir: str) -> str:
+    return posixpath.join(str(exp_dir), "telemetry")
+
+
+class JsonlSink:
+    """Append-oriented JSONL writer for one worker's telemetry file."""
+
+    def __init__(self, path: str, env=None):
+        self.path = str(path)
+        self._env = env
+        self._remote = "://" in self.path
+        self._history: List[str] = []
+        self._truncated = 0
+        self._closed = False
+
+    @property
+    def env(self):
+        if self._env is None:
+            from maggy_tpu.core.env import EnvSing
+
+            self._env = EnvSing.get_instance()
+        return self._env
+
+    def write(self, records: List[Dict[str, Any]]) -> None:
+        if self._closed or not records:
+            return
+        lines = [
+            json.dumps(rec, separators=(",", ":"), default=str) for rec in records
+        ]
+        try:
+            if self._remote:
+                self._history.extend(lines)
+                if len(self._history) > _REMOTE_MAX_RECORDS:
+                    dropped = len(self._history) - _REMOTE_MAX_RECORDS
+                    self._history = self._history[dropped:]
+                    self._truncated += dropped
+                head = (
+                    [json.dumps({"kind": "truncated", "dropped": self._truncated})]
+                    if self._truncated
+                    else []
+                )
+                self.env.dump("\n".join(head + self._history) + "\n", self.path)
+            else:
+                with self.env.open_file(self.path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+        except Exception:  # noqa: BLE001 - telemetry is best-effort, never fatal
+            pass
+
+    def close(self) -> None:
+        self._closed = True
+        self._history = []
+
+
+def worker_telemetry(partition_id, exp_dir: str, role: str = "worker", env=None):
+    """Build a worker's recorder with its JSONL sink attached — or the shared
+    no-op recorder when ``MAGGY_TPU_TELEMETRY=0``, so executors need no flag
+    checks of their own."""
+    from maggy_tpu.telemetry import recorder
+
+    if not recorder.enabled():
+        return recorder.NULL
+    tel = recorder.Telemetry(worker=partition_id, role=role)
+    name = f"worker_{partition_id}.jsonl" if role != "driver" else "driver.jsonl"
+    tel.attach_sink(JsonlSink(posixpath.join(telemetry_dir(exp_dir), name), env=env))
+    return tel

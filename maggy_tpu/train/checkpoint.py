@@ -15,6 +15,7 @@ saves inside train_fn); here it is first-class:
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, List, Optional
 
 
@@ -37,19 +38,30 @@ class Checkpointer:
     def save(self, step: int, state: Any) -> None:
         import orbax.checkpoint as ocp
 
-        self._manager.save(int(step), args=ocp.args.StandardSave(state))
+        from maggy_tpu import telemetry
+
+        tel = telemetry.get()
+        t0 = time.perf_counter()
+        with tel.span("checkpoint_save", step=int(step)):
+            self._manager.save(int(step), args=ocp.args.StandardSave(state))
+        # async saves measure the blocking (dispatch) cost — the part that
+        # actually steals step time
+        tel.gauge("checkpoint_save_ms", (time.perf_counter() - t0) * 1e3)
 
     def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
         """Restore onto the template's shardings (pass an abstract or concrete
         state built by ``Trainer.make_state``)."""
         import orbax.checkpoint as ocp
 
+        from maggy_tpu import telemetry
+
         step = int(step) if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"No checkpoint found under {self.directory}")
-        return self._manager.restore(
-            step, args=ocp.args.StandardRestore(state_template)
-        )
+        with telemetry.get().span("checkpoint_restore", step=step):
+            return self._manager.restore(
+                step, args=ocp.args.StandardRestore(state_template)
+            )
 
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()

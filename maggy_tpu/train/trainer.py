@@ -21,10 +21,12 @@ patching engine classes (SURVEY.md §7 design stance).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax.training import train_state
 
@@ -264,8 +266,6 @@ class Trainer:
             self._init_fn(),
             out_shardings=self.state_shardings_for(sample_batch, rng),
         )
-        import numpy as np
-
         # np (not jnp): host values enter a multi-process jit as replicated
         # inputs instead of arrays committed to one process's local device
         with self.mesh:
@@ -648,18 +648,53 @@ class Trainer:
         ``direction="max"`` and the train_fn returns ``-loss``, pass
         ``metric_sign=-1.0`` so live broadcasts match; there is no implicit
         negation.
+
+        Telemetry: each step records a ``train_step`` span plus
+        ``step_time_ms`` / ``tokens_per_sec`` / ``mfu_est`` gauges into the
+        ambient recorder (:func:`maggy_tpu.telemetry.get`; executors install
+        a per-worker one), and the first step — synced once to cover the XLA
+        compile — lands in ``compile_time_ms``. The returned metrics dict
+        always carries the measured ``steps_per_sec`` regardless of the
+        telemetry flag. Host wall-clock per later step is measured without
+        extra device syncs (dispatch overlaps; the device queue's
+        backpressure makes the mean converge to true step time).
         """
+        from maggy_tpu import telemetry
+
+        tel = telemetry.get()
         metrics = {}
         profiling = False
         prof_start = min(profile_steps[0], max(0, num_steps - 2))
         prof_stop = min(profile_steps[1], num_steps - 1)
+        fit_t0 = time.perf_counter()
+        tokens_per_batch = 0
+        step_ms_sum = 0.0
         try:
             for i in range(num_steps):
                 if profile_dir is not None and not profiling and i == prof_start:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
                 batch = next(data_iter)
-                state, metrics = self.step(state, self.shard_batch(batch))
+                if i == 0 and isinstance(batch, dict) and "tokens" in batch:
+                    tokens_per_batch = int(
+                        getattr(batch["tokens"], "size", 0)
+                        or np.asarray(batch["tokens"]).size
+                    )
+                t0 = time.perf_counter()
+                with tel.span("shard_batch", step=i):
+                    sharded = self.shard_batch(batch)
+                with tel.span("train_step", step=i):
+                    state, metrics = self.step(state, sharded)
+                    if i == 0 and tel.active:
+                        # one deliberate sync so the first sample covers the
+                        # XLA compile; later steps stay fully async
+                        jax.block_until_ready(metrics)
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                if i == 0:
+                    tel.gauge("compile_time_ms", dt_ms)
+                else:
+                    step_ms_sum += dt_ms
+                    tel.gauge("step_time_ms", dt_ms)
                 if profiling and i >= prof_stop:
                     jax.block_until_ready(metrics)
                     jax.profiler.stop_trace()
@@ -675,7 +710,28 @@ class Trainer:
         finally:
             if profiling:  # loop ended/raised while a trace was active
                 jax.profiler.stop_trace()
-        return state, {k: float(v) for k, v in metrics.items()}
+        out = {k: float(v) for k, v in metrics.items()}
+        # measured AFTER the float() conversions above — those force the
+        # device->host sync that makes the wall time honest
+        wall = time.perf_counter() - fit_t0
+        if num_steps > 0 and wall > 0:
+            out["steps_per_sec"] = num_steps / wall
+            tel.gauge("steps_per_sec", out["steps_per_sec"])
+            if num_steps > 1 and step_ms_sum > 0:
+                tel.gauge("step_time_ms_mean", step_ms_sum / (num_steps - 1))
+            if tokens_per_batch and tel.active:
+                tok_per_sec = tokens_per_batch * num_steps / wall
+                tel.gauge("tokens_per_sec", tok_per_sec)
+                from maggy_tpu.telemetry import flops as _flops
+
+                mfu = _flops.estimate_mfu(
+                    tok_per_sec,
+                    _flops.param_count(state.params),
+                    list(self.mesh.devices.flat),
+                )
+                if mfu is not None:
+                    tel.gauge("mfu_est", mfu)
+        return state, out
 
 
 @dataclasses.dataclass

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Lint: ban bare ``print()`` inside ``maggy_tpu/``.
+
+Framework code must route user-facing output through ``Reporter``/``Telemetry``
+(worker side — prints there vanish from pod workers and bypass the log
+shipping the driver aggregates) or ``Driver.log`` (driver side). A ``print``
+is *bare* when it has no explicit ``file=`` argument: deliberate CLI/stderr
+diagnostics (``print(..., file=sys.stderr)``) stay allowed, silent stdout
+leaks do not.
+
+Allowlisted files: ``reporter.py`` (owns the print tee itself) and
+``monitor.py`` (a CLI whose stdout IS the product).
+
+Usage: ``python tools/check_no_bare_print.py [root]`` — exits nonzero listing
+violations. Wired into the tier-1 run via ``tests/test_telemetry.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ALLOWED_FILES = {"reporter.py", "monitor.py"}
+
+
+def find_bare_prints(source: str, path: str):
+    """(line, col) of every print() call without an explicit file= kwarg."""
+    out = []
+    tree = ast.parse(source, filename=path)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+            and not any(kw.arg == "file" for kw in node.keywords)
+        ):
+            out.append((node.lineno, node.col_offset))
+    return out
+
+
+def check_tree(root: str):
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if not d.startswith((".", "_build"))]
+        for name in sorted(filenames):
+            if not name.endswith(".py") or name in ALLOWED_FILES:
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            try:
+                hits = find_bare_prints(source, path)
+            except SyntaxError as e:
+                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
+                continue
+            violations.extend((path, line, "bare print()") for line, _ in hits)
+    return violations
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = args[0] if args else os.path.join(repo, "maggy_tpu")
+    violations = check_tree(root)
+    for path, line, what in violations:
+        print(
+            f"{path}:{line}: {what} — route through Reporter/Telemetry or "
+            "pass an explicit file=",
+            file=sys.stderr,
+        )
+    if violations:
+        print(f"{len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
