@@ -310,6 +310,62 @@ def bench_ring_microbench(quick: bool = False):
     return result
 
 
+def bench_serving(quick: bool = False):
+    """Continuous-batching serving engine (maggy_tpu/serve) at a fixed
+    offered load: N requests arriving at a fixed rate into B=4 slots on a
+    tiny decoder; reports end-to-end token throughput and TTFT p50/p95 —
+    the serving-tier quantities the monitor panel renders live."""
+    import jax
+    import jax.numpy as jnp
+
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.parallel.sharding import unbox
+    from maggy_tpu.serve import Engine, SamplingParams, Scheduler
+
+    cfg = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+    model = Decoder(cfg)
+    params = unbox(
+        model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    engine = Engine(cfg, params, num_slots=4)
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    n_requests = 8 if quick else 24
+    offered_rps = 20.0  # fixed offered load
+    max_new = 16
+    try:
+        t0 = time.perf_counter()
+        reqs = []
+        for i in range(n_requests):
+            reqs.append(
+                scheduler.submit(
+                    [1 + (i % 40), 2, 3, 4 + (i % 7)],
+                    SamplingParams(max_new=max_new),
+                )
+            )
+            time.sleep(1.0 / offered_rps)
+        deadline = time.time() + 120
+        while time.time() < deadline and any(
+            r.state not in ("done", "failed") for r in reqs
+        ):
+            time.sleep(0.01)
+        wall = time.perf_counter() - t0
+        stats = scheduler.stats()
+    finally:
+        scheduler.stop()
+    done = sum(r.state == "done" for r in reqs)
+    return {
+        "n_requests": n_requests,
+        "offered_rps": offered_rps,
+        "completed": done,
+        "wall_s": round(wall, 3),
+        "tok_per_sec": round(done * max_new / wall, 1),
+        "ttft_ms_p50": round(stats["ttft_ms_p50"], 1) if stats["ttft_ms_p50"] else None,
+        "ttft_ms_p95": round(stats["ttft_ms_p95"], 1) if stats["ttft_ms_p95"] else None,
+        "decode_compiles": stats["compile_counts"]["decode"],
+    }
+
+
 def bench_asha_trials_per_hour(quick: bool = False):
     """Trials/hour through the full control plane (driver+RPC+executors) with a
     near-zero-cost train_fn — measures scheduling overhead, the quantity the
@@ -367,12 +423,17 @@ def main():
     if args.train_only:
         asha_stats = {"asha_trials_per_hour": None, "asha_wall_s": None}
         ring_stats = None
+        serving_stats = None
     else:
         asha_stats = bench_asha_trials_per_hour(quick=args.quick)
         try:
             ring_stats = bench_ring_microbench(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
             ring_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            serving_stats = bench_serving(quick=args.quick)
+        except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
+            serving_stats = {"error": f"{type(e).__name__}: {e}"}
 
     def rnd(v, digits):
         return None if v is None else round(v, digits)
@@ -394,6 +455,7 @@ def main():
             "asha_trials_per_hour": rnd(asha_stats["asha_trials_per_hour"], 1),
             "asha_wall_s": rnd(asha_stats["asha_wall_s"], 2),
             "ring_microbench": ring_stats,
+            "serving": serving_stats,
             "tuned": tuned or None,
         },
     }

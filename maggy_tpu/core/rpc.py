@@ -227,18 +227,53 @@ class Server:
     async def _handle_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        """Per-connection loop, hardened against hostile/buggy peers: an
+        oversized declared length gets an ERR reply and a close (the payload
+        cannot be skipped safely); a correctly-framed garbage payload (bad
+        JSON, or JSON that isn't an object) gets an ERR reply and the loop
+        continues — framing is still aligned; a truncated frame (peer died
+        mid-send) ends the connection silently. Every path is strictly
+        per-connection: the accept loop and other clients never notice."""
+
+        async def _reply(payload: Dict[str, Any]) -> None:
+            data = json.dumps(payload, separators=(",", ":"), default=str).encode()
+            writer.write(_LEN.pack(len(data)) + data)
+            await writer.drain()
+
+        def _frame_err(what: str) -> None:
+            if self.telemetry is not None:
+                self.telemetry.count(f"rpc_frame_errors.{what}")
+
         try:
             while True:
                 header = await reader.readexactly(_LEN.size)
                 (length,) = _LEN.unpack(header)
                 if length > constants.RPC_MAX_MESSAGE:
+                    _frame_err("oversized")
+                    await _reply(
+                        {
+                            "type": "ERR",
+                            "error": f"frame of {length} bytes exceeds cap "
+                            f"({constants.RPC_MAX_MESSAGE})",
+                        }
+                    )
                     break
-                msg = json.loads((await reader.readexactly(length)).decode("utf-8"))
+                raw = await reader.readexactly(length)
+                try:
+                    msg = json.loads(raw.decode("utf-8"))
+                except (UnicodeDecodeError, json.JSONDecodeError):
+                    _frame_err("garbage")
+                    await _reply({"type": "ERR", "error": "malformed frame payload"})
+                    continue
+                if not isinstance(msg, dict):
+                    _frame_err("not_object")
+                    await _reply(
+                        {"type": "ERR", "error": "frame payload must be a JSON object"}
+                    )
+                    continue
                 reply = self._dispatch(msg)
-                data = json.dumps(reply, separators=(",", ":"), default=str).encode()
-                writer.write(_LEN.pack(len(data)) + data)
-                await writer.drain()
-        except (asyncio.IncompleteReadError, ConnectionResetError, json.JSONDecodeError):
+                await _reply(reply)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
             writer.close()

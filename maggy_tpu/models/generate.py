@@ -15,10 +15,29 @@ Greedy (``temperature=0``) or temperature sampling with optional top-k.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def _default_rng(temperature: float, where: str) -> jax.Array:
+    """The documented-but-silent footgun: sampling (``temperature > 0``) with
+    the default ``jax.random.key(0)`` returns IDENTICAL tokens on every call.
+    Warn when it actually bites (the check runs at trace time, so it fires
+    once per compiled variant, not per step); greedy decode stays silent —
+    the fixed key is never consumed there. The serving engine
+    (maggy_tpu/serve) threads a fresh per-request key instead."""
+    if temperature > 0.0:
+        warnings.warn(
+            f"{where}: temperature sampling with the fixed default PRNG key "
+            "(jax.random.key(0)) — repeated calls return identical samples; "
+            "pass rng=jax.random.key(<fresh seed>) per call",
+            UserWarning,
+            stacklevel=3,
+        )
+    return jax.random.key(0)
 
 
 @functools.partial(
@@ -48,7 +67,7 @@ def generate(
     """
     max_len = prompt.shape[1]
     if rng is None:
-        rng = jax.random.key(0)
+        rng = _default_rng(temperature, "generate")
 
     def step(p, carry):
         tokens, rng, done = carry
@@ -99,7 +118,11 @@ def cache_shardings(mesh, abstract_cache, rules=None):
     batch_axes = shd.logical_to_mesh_axes(("batch",), rules)[0]
     tp = mesh.shape[AXIS_TENSOR]
 
-    def leaf(s):
+    def leaf(path, s):
+        # the per-row write index [(L,) B] is tiny and read by every shard —
+        # replicate (it would otherwise pattern-match the seg-track branch)
+        if "index" in jax.tree_util.keystr(path):
+            return NamedSharding(mesh, PartitionSpec())
         if s.ndim >= 4:
             kv = AXIS_TENSOR if (tp > 1 and s.shape[-2] % tp == 0) else None
             lead = (None,) * (s.ndim - 4)
@@ -112,7 +135,7 @@ def cache_shardings(mesh, abstract_cache, rules=None):
             return NamedSharding(mesh, PartitionSpec(*lead, batch_axes, None))
         return NamedSharding(mesh, PartitionSpec())
 
-    return jax.tree.map(leaf, abstract_cache)
+    return jax.tree_util.tree_map_with_path(leaf, abstract_cache)
 
 
 def init_cache(decode_model, prompt: jax.Array, mesh=None, rules=None,
@@ -201,7 +224,7 @@ def generate_cached_packed(
             f"max_seq_len ({max_seq})"
         )
     if rng is None:
-        rng = jax.random.key(0)
+        rng = _default_rng(temperature, "generate_cached_packed")
     logits, cache = prefill(decode_model, params, prompt, positions, segment_ids)
     last_pos = positions[:, -1]
     last_seg = segment_ids[:, -1]
@@ -253,7 +276,7 @@ def generate_cached(
     """
     b, max_len = prompt.shape
     if rng is None:
-        rng = jax.random.key(0)
+        rng = _default_rng(temperature, "generate_cached")
     cache = init_cache(decode_model, prompt)
 
     def step(p, carry):

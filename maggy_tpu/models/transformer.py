@@ -374,7 +374,15 @@ class Attention(nn.Module):
         attend across their boundaries. Later single-token steps may omit
         ``segment_ids``; once the ``seg`` track exists the new token extends
         the row's most recent segment. Unpacked flows never create the track
-        and keep the exact original compute."""
+        and keep the exact original compute.
+
+        The write index is PER ROW (``[B]`` int32, not a scalar): each batch
+        row carries its own cache length, so rows may sit at different
+        sequence positions — the enabler for slot-based continuous batching
+        (maggy_tpu/serve), where one compiled step decodes requests admitted
+        at different times. Lockstep callers (generate_cached, prefill) keep
+        identical values in every row and reproduce the old scalar
+        semantics exactly."""
         cfg = self.cfg
         b, t, kh, hd = k.shape
         k_cache = self.variable(
@@ -386,15 +394,17 @@ class Attention(nn.Module):
             lambda: jnp.zeros((b, cfg.max_seq_len, kh, hd), cfg.dtype),
         )
         index = self.variable(
-            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+            "cache", "index", lambda: jnp.zeros((b,), jnp.int32)
         )
-        idx = index.value
-        k_all = jax.lax.dynamic_update_slice(
-            k_cache.value, k.astype(cfg.dtype), (0, idx, 0, 0)
-        )
-        v_all = jax.lax.dynamic_update_slice(
-            v_cache.value, v.astype(cfg.dtype), (0, idx, 0, 0)
-        )
+        idx = index.value  # [B] per-row write offsets
+
+        def _row_write(cache_row, update_row, start):
+            return jax.lax.dynamic_update_slice(
+                cache_row, update_row, (start, 0, 0)
+            )
+
+        k_all = jax.vmap(_row_write)(k_cache.value, k.astype(cfg.dtype), idx)
+        v_all = jax.vmap(_row_write)(v_cache.value, v.astype(cfg.dtype), idx)
         k_cache.value = k_all
         v_cache.value = v_all
         index.value = idx + t
@@ -410,15 +420,17 @@ class Attention(nn.Module):
             if segment_ids is None:
                 # continuation: the new token(s) extend the most recent
                 # segment written for the row
-                last = jax.lax.dynamic_slice_in_dim(
-                    seg_cache.value, jnp.maximum(idx - 1, 0), 1, axis=1
-                )
+                last = jax.vmap(
+                    lambda row, i: jax.lax.dynamic_slice_in_dim(
+                        row, jnp.maximum(i - 1, 0), 1
+                    )
+                )(seg_cache.value, idx)
                 seg_q = jnp.broadcast_to(last, (b, t))
             else:
                 seg_q = segment_ids.astype(jnp.int32)
-            seg_all = jax.lax.dynamic_update_slice(
-                seg_cache.value, seg_q, (0, idx)
-            )
+            seg_all = jax.vmap(
+                lambda row, upd, i: jax.lax.dynamic_update_slice(row, upd, (i,))
+            )(seg_cache.value, seg_q, idx)
             seg_cache.value = seg_all
 
         S = cfg.max_seq_len
@@ -429,27 +441,32 @@ class Attention(nn.Module):
             chunk = S  # pathological lengths: one full-cache chunk
         h = q.shape[2]
         scale = 1.0 / (hd**0.5)
-        written = idx + t
-        # chunks covering the prefix, clamped so the final dynamic_slice can
-        # never be position-shifted by end-clamping (over-long prompt buffers)
-        n_valid = jnp.minimum((written + chunk - 1) // chunk, S // chunk)
+        written = idx + t  # [B] per-row cache lengths after this write
+        # chunks covering the LONGEST row's prefix (the loop bound must be a
+        # scalar; shorter rows mask out the excess), clamped so the final
+        # dynamic_slice can never be position-shifted by end-clamping
+        # (over-long prompt buffers)
+        n_valid = jnp.minimum(
+            (jnp.max(written) + chunk - 1) // chunk, S // chunk
+        )
 
         # a query's own write location in the cache; for packed rows this is
         # the causal clock (``positions`` restart per segment there, so they
         # cannot order keys across the whole cache)
-        qslot = idx + jnp.arange(t)
+        qslot = idx[:, None] + jnp.arange(t)[None, :]  # [B, t]
 
         def body(ci, carry):
             k_c = jax.lax.dynamic_slice_in_dim(k_all, ci * chunk, chunk, axis=1)
             v_c = jax.lax.dynamic_slice_in_dim(v_all, ci * chunk, chunk, axis=1)
             kpos = ci * chunk + jnp.arange(chunk)
+            w_row = written[:, None, None, None]  # per-row valid-key bound
             if seg_all is None:
                 # causal over the cache: a query at position p sees keys at
                 # <= p that have actually been written (positions == cache
                 # slots on this path)
                 mask = (
                     kpos[None, None, None, :] <= positions[:, None, :, None]
-                ) & (kpos < written)[None, None, None, :]
+                ) & (kpos[None, None, None, :] < w_row)
             else:
                 # packed: causal in CACHE ORDER (packing preserves a row's
                 # temporal order) and restricted to the query's own segment
@@ -457,8 +474,8 @@ class Attention(nn.Module):
                     seg_all, ci * chunk, chunk, axis=1
                 )
                 mask = (
-                    (kpos[None, None, None, :] <= qslot[None, None, :, None])
-                    & (kpos < written)[None, None, None, :]
+                    (kpos[None, None, None, :] <= qslot[:, None, :, None])
+                    & (kpos[None, None, None, :] < w_row)
                     & (seg_c[:, None, None, :] == seg_q[:, None, :, None])
                 )
             return ops_attn.online_block_update(

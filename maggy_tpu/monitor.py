@@ -130,6 +130,16 @@ def _telemetry_lines(status: dict, width: int) -> list:
             parts.append(f"compile {g['compile_time_ms'] / 1e3:.1f}s")
         if "heartbeat_rtt_ms" in g:
             parts.append(f"hb {g['heartbeat_rtt_ms']:.1f}ms")
+        if "serve.tokens_per_sec" in g:
+            parts.append(f"{g['serve.tokens_per_sec']:,.0f}tok/s")
+        if "serve.ttft_ms" in g:
+            parts.append(f"ttft {g['serve.ttft_ms']:.0f}ms")
+        if "serve.queue_depth" in g:
+            parts.append(f"queue {g['serve.queue_depth']:.0f}")
+        if "serve.active_slots" in g:
+            parts.append(f"slots {g['serve.active_slots']:.0f}")
+        if "serve.decode_retraces" in g:
+            parts.append(f"compiles {g['serve.decode_retraces']:.0f}")
         if not parts:
             continue
         lines.append(f"w{pid}: " + "  ".join(parts)[: width - 5])
@@ -176,6 +186,31 @@ def render_status(status: dict, width: int = 78) -> str:
         if tail:
             lines.append(f"-- {status.get('controller', 'controller')} decisions --")
             lines.extend(line[:width] for line in tail[-8:])
+    elif status.get("serve") is not None:
+        # serving engine panel (maggy_tpu/serve ServeServer STATUS verb)
+        sv = status["serve"]
+        bar = util.progress_bar(
+            sv.get("active_slots", 0), max(sv.get("num_slots", 1), 1), width=16
+        )
+        lines.append(
+            f"slots {bar}"
+            f"  queue={sv.get('queue_depth', 0)}"
+            f"  done={sv.get('requests_done', 0)}"
+            f"  failed={sv.get('requests_failed', 0)}"
+            + (f"  {elapsed:.0f}s" if elapsed is not None else "")
+        )
+        parts = [f"{sv.get('tokens_out', 0):,} tokens"]
+        if sv.get("tokens_per_sec"):
+            parts.append(f"{sv['tokens_per_sec']:,.0f} tok/s")
+        if sv.get("ttft_ms_p50") is not None:
+            parts.append(f"ttft p50 {sv['ttft_ms_p50']:.0f}ms")
+        if sv.get("ttft_ms_p95") is not None:
+            parts.append(f"p95 {sv['ttft_ms_p95']:.0f}ms")
+        compiles = (sv.get("compile_counts") or {}).get("decode")
+        if compiles is not None:
+            parts.append(f"decode compiles {compiles}")
+        lines.append("  ".join(parts)[:width])
+        lines.extend(_telemetry_lines(status, width))
     elif status.get("workers_done") is not None:
         lines.append(
             f"workers {status['workers_done']}/{status.get('num_executors', '?')} done"

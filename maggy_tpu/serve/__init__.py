@@ -1,0 +1,35 @@
+"""Continuous-batching serving engine.
+
+The inference tier over the decode primitives in
+:mod:`maggy_tpu.models.generate`: a fixed-slot KV-cache engine whose one
+compiled decode step serves a churning request population (admission via
+prefill into free slots, eviction on EOS/``max_new``), an FCFS scheduler
+with per-request sampling params / fresh PRNG keys / deadlines /
+cancellation, and an RPC front-end + client on the
+:mod:`maggy_tpu.core.rpc` frame protocol.
+
+    # server:  python -m maggy_tpu.serve --config tiny --slots 8
+    # client:
+    from maggy_tpu.serve import ServeClient
+    client = ServeClient((host, port), secret)
+    tokens = client.generate([1, 2, 3], max_new=16)
+
+In-process use (no sockets): build ``Engine`` + ``Scheduler`` directly.
+"""
+
+from maggy_tpu.serve.client import ServeClient  # noqa: F401
+from maggy_tpu.serve.engine import Engine  # noqa: F401
+from maggy_tpu.serve.request import Request, SamplingParams  # noqa: F401
+from maggy_tpu.serve.scheduler import Scheduler  # noqa: F401
+from maggy_tpu.serve.server import ServeServer  # noqa: F401
+from maggy_tpu.serve.slots import SlotManager  # noqa: F401
+
+__all__ = [
+    "Engine",
+    "Scheduler",
+    "ServeServer",
+    "ServeClient",
+    "SlotManager",
+    "Request",
+    "SamplingParams",
+]

@@ -1,0 +1,142 @@
+"""Serving CLI: load a checkpoint onto a mesh and serve it over RPC.
+
+    python -m maggy_tpu.serve --config tiny --slots 8
+    python -m maggy_tpu.serve --config llama3_8b --checkpoint /ckpts/run7 \
+        --mesh fsdp --slots 16 --port 7777
+
+Without ``--checkpoint`` the model is randomly initialized (``--seed``) — the
+demo/smoke path. The process prints the address and experiment secret on
+stderr; point clients (:class:`maggy_tpu.serve.ServeClient`) or the live
+monitor (``python -m maggy_tpu.monitor <host:port> <secret> --dashboard``)
+at it. With ``--exp-dir`` the engine's telemetry lands in
+``<exp_dir>/telemetry/worker_serve.jsonl`` for the Chrome-trace /
+TensorBoard exporters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+
+def build_config(name: str, max_seq_len=None):
+    """A ``DecoderConfig`` from a preset name or a JSON file of overrides."""
+    from maggy_tpu.models import DecoderConfig
+
+    presets = {"tiny": DecoderConfig.tiny, "llama3_8b": DecoderConfig.llama3_8b}
+    if name.endswith(".json"):
+        with open(name) as f:
+            cfg = DecoderConfig(**json.load(f))
+    elif name in presets:
+        cfg = presets[name]()
+    else:
+        raise SystemExit(
+            f"unknown --config {name!r}: use {sorted(presets)} or a "
+            ".json file of DecoderConfig fields"
+        )
+    if max_seq_len:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
+    return cfg
+
+
+def load_or_init_params(model, cfg, checkpoint=None, step=None, seed=0):
+    """Checkpoint params (train/checkpoint.py, params-only restore) or a
+    seeded random init for checkpoint-free demo serving."""
+    import jax
+    import jax.numpy as jnp
+
+    if checkpoint:
+        from maggy_tpu.train.checkpoint import Checkpointer
+
+        return Checkpointer(checkpoint, async_save=False).restore_params(step)
+    dummy = jnp.zeros((1, min(8, cfg.max_seq_len)), jnp.int32)
+    variables = model.init(jax.random.key(seed), dummy)
+    from maggy_tpu.parallel.sharding import unbox
+
+    return unbox(variables["params"])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m maggy_tpu.serve", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--config", default="tiny",
+                        help="DecoderConfig preset name or .json file")
+    parser.add_argument("--checkpoint", help="Checkpointer directory to restore")
+    parser.add_argument("--step", type=int, help="checkpoint step (default latest)")
+    parser.add_argument("--slots", type=int, default=4,
+                        help="KV-cache slots = max concurrent requests")
+    parser.add_argument("--mesh", default="none",
+                        help="'none' or a mesh preset (dp/fsdp/tp/...)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--secret", help="RPC secret (default: random)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="param init seed when serving without a checkpoint")
+    parser.add_argument("--max-seq-len", type=int,
+                        help="override the config's max_seq_len (cache size)")
+    parser.add_argument("--exp-dir",
+                        help="directory for telemetry JSONL export")
+    parser.add_argument("--name", default="maggy-serve")
+    args = parser.parse_args(argv)
+
+    from maggy_tpu.models import Decoder
+    from maggy_tpu.serve import Engine, Scheduler, ServeServer
+    from maggy_tpu.telemetry import worker_telemetry
+
+    cfg = build_config(args.config, args.max_seq_len)
+    model = Decoder(cfg)
+
+    mesh = None
+    if args.mesh and args.mesh != "none":
+        from maggy_tpu.parallel.mesh import mesh_for
+
+        mesh, _ = mesh_for(sharding=args.mesh)
+        print(f"[serve] mesh {args.mesh}: {dict(mesh.shape)}", file=sys.stderr)
+
+    t0 = time.time()
+    params = load_or_init_params(
+        model, cfg, checkpoint=args.checkpoint, step=args.step, seed=args.seed
+    )
+    src = args.checkpoint or f"random init (seed {args.seed})"
+    print(f"[serve] params from {src} in {time.time() - t0:.1f}s", file=sys.stderr)
+
+    tel = None
+    if args.exp_dir:
+        tel = worker_telemetry("serve", args.exp_dir, role="serve")
+    engine = Engine(
+        cfg, params, num_slots=args.slots, mesh=mesh, telemetry_recorder=tel
+    )
+    scheduler = Scheduler(engine)
+    server = ServeServer(scheduler, secret=args.secret, name=args.name)
+    host, port = server.start(host=args.host, port=args.port)
+    print(
+        f"[serve] listening on {host}:{port}\n"
+        f"[serve] secret: {server.secret}\n"
+        f"[serve] monitor: python -m maggy_tpu.monitor {host}:{port} "
+        f"{server.secret} --dashboard",
+        file=sys.stderr,
+    )
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    print("[serve] shutting down", file=sys.stderr)
+    server.stop()
+    if tel is not None:
+        tel.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

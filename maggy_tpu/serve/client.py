@@ -1,0 +1,98 @@
+"""Synchronous Python client for the serving front-end.
+
+Thin wrapper over the control-plane :class:`maggy_tpu.core.rpc.Client`
+(framed JSON, secret-authenticated, auto-reconnect) speaking the serving
+verbs. One socket per client; safe to use from multiple threads (the
+underlying client serializes the main socket).
+
+    client = ServeClient((host, port), secret)
+    rid = client.submit([1, 2, 3], max_new=8)
+    result = client.result(rid, timeout=30)   # poll until terminal
+    print(result["tokens"])
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from maggy_tpu.core import rpc
+from maggy_tpu.exceptions import RpcError
+
+
+class ServeClient:
+    def __init__(self, server_addr: Tuple[str, int], secret: str):
+        self._client = rpc.Client(tuple(server_addr), partition_id=-1, secret=secret)
+
+    def submit(
+        self,
+        prompt: List[int],
+        *,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        max_new: int = 16,
+        eos_id: int = -1,
+        seed: int = 0,
+        deadline_s: Optional[float] = None,
+    ) -> str:
+        reply = self._client._request(
+            {
+                "type": "SUBMIT",
+                "prompt": [int(t) for t in prompt],
+                "temperature": temperature,
+                "top_k": top_k,
+                "max_new": max_new,
+                "eos_id": eos_id,
+                "seed": seed,
+                "deadline_s": deadline_s,
+            }
+        )
+        return reply["id"]
+
+    def poll(self, request_id: str) -> Dict[str, Any]:
+        return self._client._request({"type": "POLL", "id": request_id})
+
+    def result(
+        self, request_id: str, timeout: float = 60.0, poll_interval: float = 0.01
+    ) -> Dict[str, Any]:
+        """Poll until the request reaches a terminal state."""
+        deadline = time.time() + timeout
+        while True:
+            snap = self.poll(request_id)
+            if snap.get("done"):
+                return snap
+            if time.time() > deadline:
+                raise RpcError(
+                    f"request {request_id} not done within {timeout}s "
+                    f"(state={snap.get('state')})"
+                )
+            time.sleep(poll_interval)
+
+    def generate(self, prompt: List[int], timeout: float = 60.0, **params) -> List[int]:
+        """submit + result convenience; returns the generated tokens."""
+        rid = self.submit(prompt, **params)
+        snap = self.result(rid, timeout=timeout)
+        if snap.get("state") != "done":
+            raise RpcError(
+                f"request {rid} ended {snap.get('state')}: {snap.get('error')}"
+            )
+        return list(snap["tokens"])
+
+    def cancel(self, request_id: str) -> bool:
+        return bool(
+            self._client._request({"type": "CANCEL", "id": request_id}).get(
+                "cancelled"
+            )
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        return self._client._request({"type": "SSTATS"})
+
+    def close(self) -> None:
+        self._client.stop()
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

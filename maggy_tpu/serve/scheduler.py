@@ -1,0 +1,281 @@
+"""FCFS request scheduler and the engine loop.
+
+One daemon thread owns the engine: it admits queued requests whenever slots
+free up (prefill interleaved with decode), decodes one token per active slot
+per iteration, and retires requests on EOS / ``max_new`` / cancellation /
+deadline. RPC handlers only touch the queue and request index under the
+scheduler lock — they never block on device work, which keeps the asyncio
+socket loop responsive while XLA crunches.
+
+Telemetry (continuously, into the ambient or provided recorder):
+``serve.queue_depth``, ``serve.active_slots``, ``serve.tokens_per_sec``
+(EMA over loop iterations), ``serve.ttft_ms`` per admission, and the
+engine's retrace gauges. Counters: ``serve.requests_{submitted,done,
+cancelled,expired,failed,rejected}`` and ``serve.tokens_out``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from maggy_tpu import telemetry
+from maggy_tpu.exceptions import BadArgumentsError
+from maggy_tpu.serve import request as rq
+from maggy_tpu.serve.engine import Engine
+from maggy_tpu.serve.request import Request, SamplingParams
+
+# terminal requests stay pollable this long after finishing
+RETENTION_S = 300.0
+# idle wait when nothing is queued or active
+IDLE_WAIT_S = 0.02
+
+
+class Scheduler:
+    def __init__(
+        self,
+        engine: Engine,
+        max_queue: int = 1024,
+        telemetry_recorder=None,
+        retention_s: float = RETENTION_S,
+    ):
+        self.engine = engine
+        self.max_queue = max_queue
+        self.retention_s = retention_s
+        self.telemetry = telemetry_recorder or engine.telemetry or telemetry.get()
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._queue: deque = deque()  # FCFS: append right, pop left
+        self._requests: Dict[str, Request] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._ttft_ms: deque = deque(maxlen=512)
+        self._started_ts = time.time()
+        self._tok_rate_ema = 0.0
+        self.counters: Dict[str, int] = {
+            "submitted": 0,
+            "done": 0,
+            "cancelled": 0,
+            "expired": 0,
+            "failed": 0,
+            "rejected": 0,
+        }
+
+    # ------------------------------------------------------------- public API
+    # (called from RPC handler threads; must not block on device work)
+
+    def submit(
+        self,
+        prompt: List[int],
+        params: Optional[SamplingParams] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Request:
+        params = params or SamplingParams()
+        params.validate()
+        if not prompt:
+            raise BadArgumentsError("empty prompt")
+        if len(prompt) + params.max_new > self.engine.max_seq_len:
+            raise BadArgumentsError(
+                f"prompt ({len(prompt)}) + max_new ({params.max_new}) "
+                f"exceeds max_seq_len ({self.engine.max_seq_len})"
+            )
+        req = Request(prompt=[int(t) for t in prompt], params=params)
+        if deadline_s is not None:
+            req.deadline_ts = time.time() + float(deadline_s)
+        with self._wake:
+            if len(self._queue) >= self.max_queue:
+                self.counters["rejected"] += 1
+                raise BadArgumentsError(
+                    f"queue full ({self.max_queue} requests waiting)"
+                )
+            self._queue.append(req)
+            self._requests[req.id] = req
+            self.counters["submitted"] += 1
+            self._wake.notify_all()
+        return req
+
+    def poll(self, request_id: str) -> Dict[str, Any]:
+        with self._lock:
+            req = self._requests.get(request_id)
+            if req is None:
+                raise BadArgumentsError(f"unknown request {request_id!r}")
+            return req.snapshot()
+
+    def cancel(self, request_id: str) -> bool:
+        """Flag a request for cancellation; the loop enacts it at the next
+        boundary (queued requests die before admission, running ones are
+        evicted after the in-flight step). Returns False for terminal or
+        unknown requests."""
+        with self._wake:
+            req = self._requests.get(request_id)
+            if req is None or req.state in rq.TERMINAL:
+                return False
+            req.cancel_requested = True
+            self._wake.notify_all()
+            return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            ttft = sorted(self._ttft_ms)
+            pct = lambda q: ttft[min(len(ttft) - 1, int(q * len(ttft)))] if ttft else None  # noqa: E731
+            return {
+                "queue_depth": len(self._queue),
+                "active_slots": self.engine.slots.active_count,
+                "num_slots": self.engine.slots.num_slots,
+                "tokens_out": self.engine.tokens_out,
+                "tokens_per_sec": round(self._tok_rate_ema, 2),
+                "steps": self.engine.steps,
+                "uptime_s": round(time.time() - self._started_ts, 3),
+                "ttft_ms_p50": pct(0.50),
+                "ttft_ms_p95": pct(0.95),
+                "compile_counts": self.engine.compile_counts,
+                **{f"requests_{k}": v for k, v in self.counters.items()},
+            }
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="maggy-serve-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until queue and slots are empty (tests/CLI shutdown)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if not self._queue and self.engine.slots.active_count == 0:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    # ------------------------------------------------------------ engine loop
+
+    def _finish(self, req: Request, state: str, error: Optional[str] = None) -> None:
+        req.finish(state, error)
+        key = {
+            rq.DONE: "done",
+            rq.CANCELLED: "cancelled",
+            rq.EXPIRED: "expired",
+            rq.FAILED: "failed",
+        }[state]
+        self.counters[key] += 1
+        self.telemetry.count(f"serve.requests_{key}")
+
+    def _emit(self, req: Request, token: int, now: float) -> bool:
+        """Append a generated token; True when the request just finished."""
+        req.tokens.append(int(token))
+        if req.first_token_ts is None:
+            req.first_token_ts = now
+            if req.ttft_ms is not None:
+                self._ttft_ms.append(req.ttft_ms)
+                self.telemetry.gauge("serve.ttft_ms", req.ttft_ms)
+        p = req.params
+        if (p.eos_id >= 0 and int(token) == p.eos_id) or len(req.tokens) >= p.max_new:
+            self._finish(req, rq.DONE)
+            return True
+        return False
+
+    def _admit_ready(self, now: float) -> None:
+        """Admit queued requests into free slots, FCFS; drop dead ones."""
+        while self.engine.slots.free_slots():
+            with self._lock:
+                if not self._queue:
+                    return
+                req = self._queue.popleft()
+            if req.cancel_requested:
+                with self._lock:
+                    self._finish(req, rq.CANCELLED)
+                continue
+            if req.deadline_ts is not None and now > req.deadline_ts:
+                with self._lock:
+                    self._finish(req, rq.EXPIRED, "deadline exceeded in queue")
+                continue
+            try:
+                slot, first = self.engine.admit(req)
+            except Exception as e:  # noqa: BLE001 - a poison request must not kill the loop
+                with self._lock:
+                    self._finish(req, rq.FAILED, f"{type(e).__name__}: {e}")
+                continue
+            with self._lock:
+                req.state = rq.RUNNING
+                req.admitted_ts = now
+                if self._emit(req, first, time.time()):
+                    self.engine.release(slot)
+
+    def _sweep_active(self, now: float) -> None:
+        """Evict running requests whose cancel flag or deadline fired."""
+        for slot in list(self.engine.slots.active_slots()):
+            req = self.engine.slots.get(slot).request
+            if req.cancel_requested:
+                with self._lock:
+                    self._finish(req, rq.CANCELLED)
+                self.engine.release(slot)
+            elif req.deadline_ts is not None and now > req.deadline_ts:
+                with self._lock:
+                    self._finish(req, rq.EXPIRED, "deadline exceeded while decoding")
+                self.engine.release(slot)
+
+    def _retire_old(self, now: float) -> None:
+        with self._lock:
+            dead = [
+                rid
+                for rid, r in self._requests.items()
+                if r.done_ts is not None and now - r.done_ts > self.retention_s
+            ]
+            for rid in dead:
+                del self._requests[rid]
+
+    def _loop(self) -> None:
+        tel = self.telemetry
+        last_flush = time.time()
+        while not self._stop.is_set():
+            now = time.time()
+            self._sweep_active(now)
+            self._admit_ready(now)
+
+            active = self.engine.slots.active_slots()
+            if active:
+                t0 = time.perf_counter()
+                out = self.engine.step()
+                dt = time.perf_counter() - t0
+                now = time.time()
+                for slot, token in out.tokens.items():
+                    req = self.engine.slots.get(slot).request
+                    with self._lock:
+                        finished = self._emit(req, token, now)
+                    if finished:
+                        self.engine.release(slot)
+                rate = len(out.tokens) / dt if dt > 0 else 0.0
+                self._tok_rate_ema = (
+                    rate if self._tok_rate_ema == 0.0
+                    else 0.9 * self._tok_rate_ema + 0.1 * rate
+                )
+                tel.gauge("serve.tokens_per_sec", self._tok_rate_ema)
+            else:
+                with self._wake:
+                    if not self._queue and not self._stop.is_set():
+                        self._wake.wait(timeout=IDLE_WAIT_S)
+
+            with self._lock:
+                tel.gauge("serve.queue_depth", len(self._queue))
+            tel.gauge("serve.active_slots", self.engine.slots.active_count)
+            if time.time() - last_flush > 1.0:
+                self._retire_old(time.time())
+                tel.flush()
+                last_flush = time.time()
+        tel.flush()

@@ -63,6 +63,36 @@ class Checkpointer:
                 step, args=ocp.args.StandardRestore(state_template)
             )
 
+    def restore_params(self, step: Optional[int] = None) -> Any:
+        """Params-only restore for serving: pull just the ``params`` subtree
+        out of a saved TrainState without rebuilding the trainer/optimizer,
+        unboxing flax ``Partitioned`` wrappers (template-free restores
+        return them as ``{"value": array}`` dicts) down to raw arrays —
+        exactly what ``model.apply({"params": ...})`` and the serve engine
+        take."""
+        from maggy_tpu import telemetry
+
+        step = int(step) if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint found under {self.directory}")
+        with telemetry.get().span("checkpoint_restore_params", step=step):
+            restored = self._manager.restore(step)
+        tree = restored if isinstance(restored, dict) else restored.__dict__
+        if "params" not in tree:
+            raise ValueError(
+                f"checkpoint at step {step} has no 'params' subtree "
+                f"(keys: {sorted(tree)})"
+            )
+
+        def unbox(node):
+            if isinstance(node, dict):
+                if "value" in node and not isinstance(node["value"], dict):
+                    return node["value"]
+                return {k: unbox(v) for k, v in node.items()}
+            return node
+
+        return unbox(tree["params"])
+
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()
 

@@ -143,3 +143,87 @@ def test_large_frame_roundtrip(server):
     blob = "x" * 1_000_000
     assert c._request({"type": "ECHO", "blob": blob})["blob"] == blob
     c.stop()
+
+
+# ------------------------------------------------------------ frame robustness
+# Hostile/buggy peers must produce clean per-connection errors, never a wedged
+# server loop (ISSUE 2 satellite).
+
+
+import socket as socket_mod  # noqa: E402
+
+from maggy_tpu import constants  # noqa: E402
+
+
+def _raw_conn(server):
+    sock = socket_mod.create_connection((server.host, server.port), timeout=5)
+    sock.settimeout(5)
+    return sock
+
+
+def _server_still_serves(server):
+    """A fresh well-formed client works — the accept loop survived."""
+    c = client_for(server, pid=9)
+    try:
+        assert c._request({"type": "QUERY"})["type"] == "QUERY"
+    finally:
+        c.stop()
+
+
+def test_oversized_frame_gets_err_and_close(server):
+    sock = _raw_conn(server)
+    try:
+        # declared length over the cap; no payload follows
+        sock.sendall(rpc._LEN.pack(constants.RPC_MAX_MESSAGE + 1))
+        reply = rpc.recv_frame(sock)
+        assert reply["type"] == "ERR" and "exceeds cap" in reply["error"]
+        # the server closes this connection afterwards
+        with pytest.raises(RpcError, match="closed by peer"):
+            rpc.recv_frame(sock)
+    finally:
+        sock.close()
+    _server_still_serves(server)
+
+
+def test_garbage_payload_gets_err_and_connection_survives(server):
+    sock = _raw_conn(server)
+    try:
+        blob = b"\xff\x00\xfenot json at all"
+        sock.sendall(rpc._LEN.pack(len(blob)) + blob)
+        reply = rpc.recv_frame(sock)
+        assert reply["type"] == "ERR" and "malformed" in reply["error"]
+        # framing stayed aligned: the same connection still handles real verbs
+        rpc.send_frame(
+            sock, {"type": "QUERY", "secret": server.secret, "partition_id": 0}
+        )
+        assert rpc.recv_frame(sock)["type"] == "QUERY"
+    finally:
+        sock.close()
+
+
+def test_non_object_payload_gets_err(server):
+    sock = _raw_conn(server)
+    try:
+        blob = b'[1, 2, 3]'
+        sock.sendall(rpc._LEN.pack(len(blob)) + blob)
+        reply = rpc.recv_frame(sock)
+        assert reply["type"] == "ERR" and "JSON object" in reply["error"]
+    finally:
+        sock.close()
+
+
+def test_truncated_frame_disconnect_is_clean(server):
+    sock = _raw_conn(server)
+    # declare 100 bytes, send 10, vanish mid-frame
+    sock.sendall(rpc._LEN.pack(100) + b"0123456789")
+    sock.close()
+    _server_still_serves(server)
+
+
+def test_send_frame_rejects_oversized_client_side():
+    class _NullSock:
+        def sendall(self, data):
+            raise AssertionError("oversized frame must not reach the wire")
+
+    with pytest.raises(RpcError, match="exceeds frame cap"):
+        rpc.send_frame(_NullSock(), {"blob": "x" * (constants.RPC_MAX_MESSAGE + 1)})

@@ -1,0 +1,263 @@
+"""Serving engine: slot lifecycle invariants under churn, greedy-decode
+equivalence with one-shot ``generate_cached``, compile-once decode step, and
+per-request PRNG isolation (ISSUE 2 tentpole + satellites)."""
+
+import dataclasses
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maggy_tpu.exceptions import BadArgumentsError
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.models.generate import generate_cached
+from maggy_tpu.parallel.sharding import unbox
+from maggy_tpu.serve import Engine, Request, SamplingParams, Scheduler, SlotManager
+from maggy_tpu.serve.slots import SlotOccupiedError
+
+# float32 so one-pass prefill and token-by-token cache fill agree bit-for-bit
+# on greedy argmax (the bf16 tie-break caveat the packed tests tolerate)
+CFG = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def served():
+    model = Decoder(CFG)
+    params = unbox(
+        model.init(jax.random.key(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    return model, params
+
+
+def make_engine(params, num_slots=4):
+    return Engine(CFG, params, num_slots=num_slots)
+
+
+def reference(params, prompt, max_new, temperature=0.0, rng=None):
+    """One-shot generate_cached over the same prompt/params."""
+    decode_model = Decoder(dataclasses.replace(CFG, decode=True))
+    buf = np.zeros((1, len(prompt) + max_new), np.int32)
+    buf[0, : len(prompt)] = prompt
+    out = generate_cached(
+        decode_model,
+        params,
+        jnp.asarray(buf),
+        jnp.asarray([len(prompt)]),
+        temperature=temperature,
+        rng=rng,
+    )
+    return np.asarray(out)[0, len(prompt):]
+
+
+def run_all(scheduler, requests, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(r.state in ("done", "failed", "cancelled", "expired") for r in requests):
+            return
+        time.sleep(0.01)
+    raise AssertionError(
+        f"requests stuck: {[(r.id, r.state) for r in requests]}"
+    )
+
+
+# --------------------------------------------------------------------- slots
+
+
+def test_slot_manager_invariants():
+    sm = SlotManager(2)
+    r1, r2, r3 = (Request(prompt=[1, 2]) for _ in range(3))
+    s1 = sm.admit(r1, first_token=5)
+    sm.check_invariants()
+    s2 = sm.admit(r2, first_token=6)
+    assert {s1, s2} == {0, 1} and not sm.free_slots()
+    with pytest.raises(SlotOccupiedError, match="no free slot"):
+        sm.admit(r3, first_token=7)
+    # double-admit of the same request is an invariant violation
+    sm.evict(s1)
+    sm.check_invariants()
+    with pytest.raises(SlotOccupiedError, match="already in a slot"):
+        sm.admit(r2, first_token=8)
+    with pytest.raises(SlotOccupiedError, match="already free"):
+        sm.evict(s1)
+    # freed slot is reusable
+    s3 = sm.admit(r3, first_token=7)
+    assert s3 == s1
+    st = sm.get(s3)
+    assert st.next_pos == 2 and st.generated == 1 and st.last_token == 7
+    sm.advance(s3, 9)
+    assert st.next_pos == 3 and st.last_token == 9
+    sm.check_invariants()
+
+
+def test_slot_churn_reuses_all_slots(served):
+    """Admission under churn lands on freed slots; the host mirror never
+    leaks or double-books."""
+    _, params = served
+    engine = make_engine(params, num_slots=2)
+    seen_slots = set()
+    for i in range(6):
+        req = Request(prompt=[1 + i, 2, 3], params=SamplingParams(max_new=2))
+        slot, _ = engine.admit(req)
+        seen_slots.add(slot)
+        engine.slots.check_invariants()
+        if engine.slots.active_count == 2:
+            engine.step()
+            engine.release(engine.slots.active_slots()[0])
+            engine.slots.check_invariants()
+    assert seen_slots == {0, 1}
+
+
+# -------------------------------------------------------------- equivalence
+
+
+def test_greedy_engine_matches_one_shot(served):
+    """Acceptance: every request's greedy output equals one-shot
+    generate_cached on the same prompt — requests admitted at different
+    times into different slots, decoded in one shared compiled step."""
+    _, params = served
+    engine = make_engine(params, num_slots=4)
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        prompts = [
+            [1, 2, 3, 4],
+            [5, 6, 7],
+            [9, 10, 11, 12, 13],
+            [2, 4, 6, 8, 10, 12],
+            [7, 3],
+            [40, 41, 42],
+        ]
+        reqs = [
+            scheduler.submit(p, SamplingParams(max_new=6)) for p in prompts
+        ]
+        run_all(scheduler, reqs)
+    finally:
+        scheduler.stop()
+    for req, prompt in zip(reqs, prompts):
+        assert req.state == "done", (req.state, req.error)
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens),
+            reference(params, prompt, 6),
+            err_msg=f"prompt {prompt}: engine diverges from generate_cached",
+        )
+
+
+def test_eos_stops_early(served):
+    _, params = served
+    # find the token greedy decode emits second, use it as eos
+    ref = reference(params, [1, 2, 3], 6)
+    eos = int(ref[1])
+    engine = make_engine(params)
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        req = scheduler.submit(
+            [1, 2, 3], SamplingParams(max_new=6, eos_id=eos)
+        )
+        run_all(scheduler, [req])
+    finally:
+        scheduler.stop()
+    assert req.state == "done"
+    assert req.tokens[-1] == eos and len(req.tokens) == 2
+
+
+# -------------------------------------------------------------- compile-once
+
+
+def test_decode_step_compiles_once_under_churn(served):
+    """The whole point of slot-based static shapes: request churn (varying
+    prompt lengths, admissions interleaved with decode) never retraces the
+    decode step. Prefill compiles per power-of-two bucket only."""
+    _, params = served
+    engine = make_engine(params, num_slots=3)
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        reqs = []
+        for i in range(9):
+            plen = 2 + (i * 3) % 11  # lengths spread over 2..12
+            reqs.append(
+                scheduler.submit(
+                    list(range(1, plen + 1)),
+                    SamplingParams(max_new=3 + (i % 4)),
+                )
+            )
+            time.sleep(0.02)  # staggered arrivals -> admissions mid-decode
+        run_all(scheduler, reqs)
+    finally:
+        scheduler.stop()
+    assert all(r.state == "done" for r in reqs)
+    counts = engine.compile_counts
+    assert counts["decode"] == 1, counts
+    assert counts["admit"] == 1, counts
+    # prompt lengths 2..12 span buckets 8 and 16 only
+    assert counts["prefill"] <= 2, counts
+
+
+# ---------------------------------------------------------------------- RNG
+
+
+def test_sampling_deterministic_per_seed_and_slot_independent(served):
+    """A request's sampled output is a function of (prompt, params, seed) —
+    not of which slot it lands in or which other requests share the batch."""
+    _, params = served
+    engine = make_engine(params, num_slots=3)
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        sp = SamplingParams(max_new=8, temperature=1.0, top_k=8, seed=123)
+        # run 1: alone
+        a = scheduler.submit([9, 9, 9], sp)
+        run_all(scheduler, [a])
+        # run 2: same request packed next to noise neighbours
+        noise = [
+            scheduler.submit([3 + i, 5], SamplingParams(max_new=10, temperature=0.7, seed=i))
+            for i in range(2)
+        ]
+        b = scheduler.submit([9, 9, 9], sp)
+        c = scheduler.submit([9, 9, 9], dataclasses.replace(sp, seed=124))
+        run_all(scheduler, noise + [b, c])
+    finally:
+        scheduler.stop()
+    assert a.tokens == b.tokens, "slot/batch neighbours changed sampled output"
+    assert a.tokens != c.tokens, "different seeds produced identical samples"
+
+
+def test_default_rng_warns_when_sampling(served):
+    """Satellite: the silent fixed-key footgun now warns — sampling with the
+    default key on any generate path, but never for greedy decode."""
+    _, params = served
+    decode_model = Decoder(dataclasses.replace(CFG, decode=True))
+    buf = jnp.asarray(np.zeros((1, 21), np.int32))  # unique shape -> fresh trace
+    plen = jnp.asarray([2])
+    with pytest.warns(UserWarning, match="fixed default PRNG key"):
+        generate_cached(decode_model, params, buf, plen, temperature=0.73)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        generate_cached(decode_model, params, buf, plen)  # greedy: silent
+
+
+# ------------------------------------------------------------------- limits
+
+
+def test_submit_validates_length_and_params(served):
+    _, params = served
+    engine = make_engine(params)
+    scheduler = Scheduler(engine)
+    with pytest.raises(BadArgumentsError, match="max_seq_len"):
+        scheduler.submit(list(range(60)), SamplingParams(max_new=10))
+    with pytest.raises(BadArgumentsError, match="empty prompt"):
+        scheduler.submit([], SamplingParams())
+    with pytest.raises(ValueError, match="max_new"):
+        scheduler.submit([1], SamplingParams(max_new=0))
+
+
+def test_admit_without_free_slot_raises(served):
+    _, params = served
+    engine = make_engine(params, num_slots=1)
+    engine.admit(Request(prompt=[1, 2], params=SamplingParams(max_new=4)))
+    with pytest.raises(SlotOccupiedError):
+        engine.admit(Request(prompt=[3, 4], params=SamplingParams(max_new=4)))

@@ -1,0 +1,227 @@
+"""Serving front-end over RPC: the full engine+scheduler+server+client path
+on CPU. The smoke test IS the ISSUE 2 acceptance demo: >= 8 staggered
+requests through B=4 slots with (a) greedy outputs equal to one-shot
+``generate_cached``, (b) exactly one decode-step compile for the whole run
+(asserted via the compile-count telemetry), and (c) TTFT / queue-depth /
+tokens-per-sec gauges in the exported telemetry JSONL and the monitor
+panel."""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.models.generate import generate_cached
+from maggy_tpu.parallel.sharding import unbox
+from maggy_tpu.serve import Engine, Scheduler, ServeClient, ServeServer
+
+CFG = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Decoder(CFG)
+    return unbox(
+        model.init(jax.random.key(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+
+
+def reference(params, prompt, max_new):
+    decode_model = Decoder(dataclasses.replace(CFG, decode=True))
+    buf = np.zeros((1, len(prompt) + max_new), np.int32)
+    buf[0, : len(prompt)] = prompt
+    out = generate_cached(
+        decode_model, params, jnp.asarray(buf), jnp.asarray([len(prompt)])
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+def serve_stack(params, tmp_path=None, num_slots=4):
+    """(server, telemetry) — telemetry JSONL-backed when tmp_path given."""
+    tel = None
+    if tmp_path is not None:
+        from maggy_tpu.telemetry import worker_telemetry
+
+        tel = worker_telemetry("serve", str(tmp_path), role="serve")
+    engine = Engine(CFG, params, num_slots=num_slots, telemetry_recorder=tel)
+    server = ServeServer(Scheduler(engine))
+    return server, tel
+
+
+def test_acceptance_demo_staggered_requests(params, tmp_path, tmp_env):
+    """8 requests, staggered arrivals, B=4 — the acceptance criteria."""
+    server, tel = serve_stack(params, tmp_path, num_slots=4)
+    host, port = server.start(host="127.0.0.1")
+    prompts = [
+        [1, 2, 3, 4],
+        [5, 6, 7],
+        [9, 10, 11, 12, 13],
+        [2, 4, 6, 8, 10, 12],
+        [7, 3],
+        [20, 21, 22, 23],
+        [30, 31],
+        [40, 41, 42, 44, 45, 46, 47],
+    ]
+    max_new = 6
+    results = {}
+    errors = []
+
+    def drive(i, prompt, delay):
+        try:
+            time.sleep(delay)
+            with ServeClient((host, port), server.secret) as client:
+                results[i] = client.generate(prompt, max_new=max_new, timeout=90)
+        except Exception as e:  # noqa: BLE001 - surfaced via the errors list
+            errors.append((i, repr(e)))
+
+    try:
+        threads = [
+            threading.Thread(target=drive, args=(i, p, 0.03 * i))
+            for i, p in enumerate(prompts)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert len(results) == len(prompts)
+
+        # (a) greedy equivalence with one-shot generate_cached, per request
+        for i, prompt in enumerate(prompts):
+            assert results[i] == reference(params, prompt, max_new), (
+                f"request {i} (prompt {prompt}) diverges from one-shot decode"
+            )
+
+        # (b) the decode step compiled exactly once across the whole run
+        with ServeClient((host, port), server.secret) as client:
+            stats = client.stats()
+            status = client._client._request({"type": "STATUS"})
+        assert stats["compile_counts"]["decode"] == 1, stats["compile_counts"]
+        assert stats["requests_done"] == len(prompts)
+        assert stats["tokens_out"] >= len(prompts) * max_new
+        assert stats["ttft_ms_p50"] is not None
+
+        # (c1) monitor panel renders the serving status
+        from maggy_tpu.monitor import render_status
+
+        panel = render_status(status)
+        assert "slots" in panel and "queue=" in panel
+        assert "ttft p50" in panel and "decode compiles 1" in panel
+    finally:
+        server.stop()
+
+    # (c2) gauges landed in the exported telemetry JSONL
+    assert tel is not None
+    tel.close()
+    path = os.path.join(str(tmp_path), "telemetry", "worker_serve.jsonl")
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    gauge_names = {r["name"] for r in records if r.get("kind") == "gauge"}
+    for expected in (
+        "serve.ttft_ms",
+        "serve.queue_depth",
+        "serve.tokens_per_sec",
+        "serve.active_slots",
+        "serve.decode_retraces",
+    ):
+        assert expected in gauge_names, (expected, sorted(gauge_names))
+    # the recorded retrace gauge agrees with the compile-once assertion
+    retraces = [
+        r["value"] for r in records
+        if r.get("kind") == "gauge" and r["name"] == "serve.decode_retraces"
+    ]
+    assert retraces and max(retraces) == 1.0
+
+
+def test_cancel_and_deadline(params):
+    server, _ = serve_stack(params)
+    host, port = server.start(host="127.0.0.1")
+    try:
+        with ServeClient((host, port), server.secret) as client:
+            # cancel mid-decode: a long request is stopped well short
+            rid = client.submit([1, 2, 3], max_new=50)
+            time.sleep(0.2)
+            assert client.cancel(rid)
+            snap = client.result(rid, timeout=30)
+            assert snap["state"] == "cancelled"
+            assert snap["n_tokens"] < 50
+            # cancel of a finished request reports False
+            done = client.submit([4, 5], max_new=2)
+            client.result(done, timeout=30)
+            assert client.cancel(done) is False
+            # a deadline in the past expires without decoding
+            rid = client.submit([6, 7, 8], max_new=20, deadline_s=-0.1)
+            snap = client.result(rid, timeout=30)
+            assert snap["state"] == "expired"
+            assert snap["error"]
+    finally:
+        server.stop()
+
+
+def test_submit_validation_over_rpc(params):
+    from maggy_tpu.exceptions import RpcError
+
+    server, _ = serve_stack(params)
+    host, port = server.start(host="127.0.0.1")
+    try:
+        with ServeClient((host, port), server.secret) as client:
+            with pytest.raises(RpcError, match="max_seq_len"):
+                client.submit(list(range(60)), max_new=20)
+            with pytest.raises(RpcError, match="list of token ids"):
+                client._client._request({"type": "SUBMIT", "prompt": "oops"})
+            with pytest.raises(RpcError, match="unknown request"):
+                client.poll("nonexistent")
+            # the connection survives every rejected submit
+            assert client.stats()["requests_submitted"] == 0
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_churn_soak(params):
+    """Slot churn under sustained mixed load: staggered arrivals, varied
+    lengths/sampling, cancellations sprinkled in — every request terminates,
+    the decode step never recompiles, and greedy requests still match their
+    one-shot reference afterwards."""
+    server, _ = serve_stack(params, num_slots=3)
+    host, port = server.start(host="127.0.0.1")
+    rng = np.random.default_rng(0)
+    try:
+        with ServeClient((host, port), server.secret) as client:
+            greedy_cases = {}
+            ids = []
+            for i in range(40):
+                plen = int(rng.integers(2, 14))
+                prompt = [int(t) for t in rng.integers(1, CFG.vocab_size, plen)]
+                max_new = int(rng.integers(1, 10))
+                greedy = i % 3 != 0
+                rid = client.submit(
+                    prompt,
+                    max_new=max_new,
+                    temperature=0.0 if greedy else 0.9,
+                    seed=i,
+                )
+                if greedy:
+                    greedy_cases[rid] = (prompt, max_new)
+                ids.append(rid)
+                if i % 7 == 0:
+                    client.cancel(rid)
+                time.sleep(float(rng.uniform(0.0, 0.02)))
+            snaps = {rid: client.result(rid, timeout=180) for rid in ids}
+            stats = client.stats()
+        assert all(s["done"] for s in snaps.values())
+        assert stats["compile_counts"]["decode"] == 1, stats["compile_counts"]
+        assert stats["requests_failed"] == 0, stats
+        for rid, (prompt, max_new) in greedy_cases.items():
+            if snaps[rid]["state"] != "done":
+                continue  # cancelled greedy request
+            assert snaps[rid]["tokens"] == reference(params, prompt, max_new)
+    finally:
+        server.stop()
