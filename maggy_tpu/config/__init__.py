@@ -2,6 +2,7 @@ from maggy_tpu.config.base import LagomConfig, BaseConfig
 from maggy_tpu.config.hpo import HyperparameterOptConfig
 from maggy_tpu.config.ablation import AblationConfig
 from maggy_tpu.config.distributed import DistributedConfig
+from maggy_tpu.config.tune import TuneConfig
 
 # Convenience alias mirroring the reference's config split (TorchDistributedConfig /
 # TfDistributedConfig, config/torch_distributed.py:28 + config/tf_distributed.py:26):
@@ -15,4 +16,5 @@ __all__ = [
     "AblationConfig",
     "DistributedConfig",
     "TpuDistributedConfig",
+    "TuneConfig",
 ]

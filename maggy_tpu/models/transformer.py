@@ -507,8 +507,15 @@ class MLPBlock(nn.Module):
 
 def _constrain_residual(x):
     """Pin the residual stream's layout: batch over (data, fsdp), seq over sp,
-    embed replicated — on deep tp/fsdp/sp meshes GSPMD propagation can
-    otherwise drift into accidental activation all-gathers (TODO round 2)."""
+    embed replicated. Settled behavior: every DecoderLayer exit re-asserts
+    this one canonical placement, because on deep tp/fsdp/sp meshes GSPMD
+    propagation from the tensor-sharded projections can otherwise drift the
+    residual into an embed-sharded (or gathered) layout mid-stack and pay an
+    all-gather per layer. The embed dim stays deliberately REPLICATED — a
+    per-layer reduce-scatter/all-gather pair costs more than it saves at the
+    d_models this family targets — and inside manual (shard_map) regions the
+    constraint is a no-op by construction (constrain_activation degrades
+    there), so the pipeline stage adapter composes with it unchanged."""
     from maggy_tpu.parallel.sharding import constrain_activation
 
     return constrain_activation(x, ("batch", "activation_seq", None))

@@ -140,6 +140,14 @@ def _telemetry_lines(status: dict, width: int) -> list:
             parts.append(f"slots {g['serve.active_slots']:.0f}")
         if "serve.decode_retraces" in g:
             parts.append(f"compiles {g['serve.decode_retraces']:.0f}")
+        # autotuner progress (maggy_tpu/tune): candidate grid, AOT prunes,
+        # and the best measured step time so far
+        if "tune.candidates" in g:
+            parts.append(f"tune {g['tune.candidates']:.0f} cand")
+        if "tune.pruned_oom" in g:
+            parts.append(f"oom-pruned {g['tune.pruned_oom']:.0f}")
+        if "tune.best_step_time" in g:
+            parts.append(f"best {g['tune.best_step_time']:.1f}ms/step")
         if not parts:
             continue
         lines.append(f"w{pid}: " + "  ".join(parts)[: width - 5])

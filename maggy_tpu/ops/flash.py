@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from maggy_tpu.ops.attention import NEG_INF, _repeat_kv, blockwise_attention
+from maggy_tpu.util import shard_map
 
 _LANES = 128
 
@@ -599,12 +600,12 @@ def sharded_flash_attention(
     spec = P((AXIS_DATA, AXIS_FSDP), None, AXIS_TENSOR, None)
     fn = functools.partial(flash_attention, causal=causal, interpret=interpret)
     if segment_ids is None:
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(q, k, v)
     seg_spec = P((AXIS_DATA, AXIS_FSDP), None)
-    return jax.shard_map(
+    return shard_map(
         lambda q, k, v, s: fn(q, k, v, segment_ids=s),
         mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
         check_vma=False,

@@ -40,15 +40,40 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from maggy_tpu.util import shard_map
 
 NEG_INF = -1e30
+
+# pallas-TPU API names across jax versions (new: MemorySpace/CompilerParams,
+# old <= 0.4.x: TPUMemorySpace/TPUCompilerParams — same members, minus kwargs
+# the old dataclass doesn't know, which _compiler_params drops)
+_MEMSPACE = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+
+
+def _compiler_params(**kwargs):
+    import dataclasses as _dc
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    known = {f.name for f in _dc.fields(cls)}
+    return cls(**{k: v for k, v in kwargs.items() if k in known})
+
+
+def _interpret_mode(flag: bool):
+    """pallas_call interpret argument: the TPU interpret machine
+    (InterpretParams, emulates remote DMAs) where available, else the plain
+    boolean interpreter of older jax."""
+    if not flag:
+        return False
+    params = getattr(pltpu, "InterpretParams", None)
+    return params() if params is not None else True
 
 
 def _neighbor(mesh, axis_name: str, offset: int):
     """Mesh coordinates of the ring neighbor at ``offset`` along ``axis_name``
     (same pattern as pallas's reference all-gather kernel)."""
     idx = lax.axis_index(axis_name)
-    size = lax.axis_size(axis_name)
+    # static axis extent from the mesh (lax.axis_size only exists on new jax)
+    size = dict(mesh.shape)[axis_name]
     nxt = lax.rem(idx + offset + size, size)
     return tuple(
         nxt if name == axis_name else lax.axis_index(name)
@@ -330,7 +355,7 @@ def _ring_flash_local(q, k, v, *, mesh, axis_name, num_shards, causal,
         jax.ShapeDtypeStruct((B, C, KH, G), f32),          # m
         jax.ShapeDtypeStruct((B, C, KH, G), f32),          # l
     )
-    any_spec = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
+    any_spec = pl.BlockSpec(memory_space=_MEMSPACE.ANY)
     o = pl.pallas_call(
         kernel,
         grid=(B, KH),
@@ -350,12 +375,10 @@ def _ring_flash_local(q, k, v, *, mesh, axis_name, num_shards, causal,
             pltpu.SemaphoreType.REGULAR((B, KH)),      # ack
             pltpu.SemaphoreType.DMA((8,)),             # local staging sems
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             collective_id=7, has_side_effects=True
         ),
-        interpret=(
-            pltpu.InterpretParams() if interpret else False
-        ),
+        interpret=_interpret_mode(interpret),
     )(qg, k, v)
     if return_stats:
         return o[0].reshape(B, C, H, D), o[4], o[5]
@@ -688,7 +711,7 @@ def _ring_bwd_local(q, k, v, o, do, lse, *, mesh, axis_name, num_shards,
         jax.ShapeDtypeStruct((B, KH, 2, C, D), f32),       # dkbuf
         jax.ShapeDtypeStruct((B, KH, 2, C, D), f32),       # dvbuf
     )
-    any_spec = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
+    any_spec = pl.BlockSpec(memory_space=_MEMSPACE.ANY)
     out = pl.pallas_call(
         kernel,
         grid=(B, KH),
@@ -719,12 +742,10 @@ def _ring_bwd_local(q, k, v, o, do, lse, *, mesh, axis_name, num_shards,
             pltpu.SemaphoreType.REGULAR((B, KH)),      # ack_dkv
             pltpu.SemaphoreType.DMA((10,)),            # local staging sems
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             collective_id=8, has_side_effects=True
         ),
-        interpret=(
-            pltpu.InterpretParams() if interpret else False
-        ),
+        interpret=_interpret_mode(interpret),
     )(qg, k, v, og, dog, lse)
     dq = out[0].reshape(B, C, H, D).astype(q.dtype)
     dk = out[1].astype(k.dtype)
@@ -779,7 +800,7 @@ def ring_flash_attention(
     )
 
     def _fwd_stats(q, k, v):
-        return jax.shard_map(
+        return shard_map(
             functools.partial(_ring_flash_local, return_stats=True, **kw),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -799,7 +820,7 @@ def ring_flash_attention(
 
     def attn_bwd(res, g):
         q, k, v, o, lse = res
-        return jax.shard_map(
+        return shard_map(
             functools.partial(_ring_bwd_local, **kw),
             mesh=mesh,
             in_specs=(spec, spec, spec, spec, spec, stat_spec),

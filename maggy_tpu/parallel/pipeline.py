@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from maggy_tpu.parallel.spec import AXIS_DATA, AXIS_FSDP, AXIS_STAGE
+from maggy_tpu.util import shard_map
 
 
 def _manual_axes(mesh, axis_name) -> frozenset:
@@ -147,7 +148,7 @@ def pipeline_apply(
         out_spec = P(axis_name, (AXIS_DATA, AXIS_FSDP))
     else:
         out_spec = batch_spec
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis_name), batch_spec),
@@ -395,7 +396,7 @@ def pipeline_grads_1f1b(
         return reduce_scalar(loss_acc), grads, reduce_scalar(aux_acc)
 
     batch_spec = P(None, (AXIS_DATA, AXIS_FSDP))
-    loss, grads, aux = jax.shard_map(
+    loss, grads, aux = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis_name), batch_spec, batch_spec),
@@ -502,7 +503,7 @@ def pipeline_forward_loss(
         return reduce_scalar(loss_acc), reduce_scalar(aux_acc)
 
     batch_spec = P(None, (AXIS_DATA, AXIS_FSDP))
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis_name), batch_spec, batch_spec),

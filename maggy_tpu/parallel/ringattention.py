@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from maggy_tpu.ops import attention as ops_attn
 from maggy_tpu.parallel.spec import AXIS_SEQ
+from maggy_tpu.util import shard_map
 
 
 def _local_ring_attention(
@@ -162,7 +163,7 @@ def _xla_ring(q, k, v, segment_ids, *, mesh, causal, axis_name):
         causal=causal,
         use_segments=use_segments,
     )
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec, seg_spec),

@@ -235,10 +235,21 @@ def constrain_activation(x, logical_axes, rules=DEFAULT_RULES):
         if not am.empty and manual is not None and manual in set(am.axis_types):
             return x
     except AttributeError:
-        # only for a removed introspection API on older jax; anything else
-        # must stay loud — silently skipping this guard would let an
-        # Auto-mesh constraint poison a Manual region's vjp
-        pass
+        # removed/not-yet-added introspection API on older jax: detect the
+        # manual region through the trace axis-env instead — shard_map binds
+        # its manual axes there, so any mesh axis appearing bound means we
+        # are inside a manual body and the constraint must be skipped.
+        # Anything beyond these two probes must stay loud — silently
+        # skipping this guard would let an Auto-mesh constraint poison a
+        # Manual region's vjp.
+        try:
+            from jax._src.core import trace_ctx
+
+            bound = set(getattr(trace_ctx.axis_env, "axis_sizes", {}) or {})
+        except (ImportError, AttributeError):
+            bound = set()
+        if bound & set(mesh.axis_names):
+            return x
     axes = list(logical_to_mesh_axes(logical_axes, rules))
     for i, axis in enumerate(axes):
         ext = mesh_extent(mesh, axis)

@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 
 from maggy_tpu.ops.attention import _repeat_kv, blockwise_attention
 from maggy_tpu.parallel.spec import AXIS_SEQ
+from maggy_tpu.util import shard_map
 
 
 def _local_ulysses(
@@ -98,7 +99,7 @@ def ulysses_attention(
         attn_fn=inner,
         use_segments=use_segments,
     )
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh,
         in_specs=(spec, spec, spec, P(None, axis_name)),
         out_specs=spec, check_vma=False,

@@ -73,7 +73,10 @@ def main(argv=None) -> int:
     parser.add_argument("--slots", type=int, default=4,
                         help="KV-cache slots = max concurrent requests")
     parser.add_argument("--mesh", default="none",
-                        help="'none' or a mesh preset (dp/fsdp/tp/...)")
+                        help="'none', a mesh preset (dp/fsdp/tp/...), or "
+                             "'auto' to consult the autotuner cache "
+                             "(maggy_tpu.tune) for this model+topology and "
+                             "fall back to 'none' on a miss")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--secret", help="RPC secret (default: random)")
@@ -94,7 +97,28 @@ def main(argv=None) -> int:
     model = Decoder(cfg)
 
     mesh = None
-    if args.mesh and args.mesh != "none":
+    if args.mesh == "auto":
+        # tuned-winner lookup (grid-independent alias on the env seam);
+        # cache-only — never compiles — so startup cost is one JSON read
+        from maggy_tpu.tune import cached_best
+
+        tuned = cached_best(model)
+        if tuned is not None:
+            tuned.apply_env()
+            mesh = tuned.mesh()
+            print(
+                f"[serve] mesh auto: tuning cache hit -> {dict(mesh.shape)} "
+                f"(source={tuned.source})",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "[serve] mesh auto: no tuning-cache record for this "
+                "model/topology (run python -m maggy_tpu.tune); serving "
+                "unsharded",
+                file=sys.stderr,
+            )
+    elif args.mesh and args.mesh != "none":
         from maggy_tpu.parallel.mesh import mesh_for
 
         mesh, _ = mesh_for(sharding=args.mesh)

@@ -14,9 +14,15 @@ saves inside train_fn); here it is first-class:
 
 from __future__ import annotations
 
+import json
 import os
 import time
-from typing import Any, List, Optional
+import warnings
+from typing import Any, Dict, List, Optional
+
+# sidecar directory (non-numeric name: invisible to orbax's step scan)
+# holding one JSON per step with the system config the state was saved under
+_META_DIR = "system_meta"
 
 
 class Checkpointer:
@@ -35,7 +41,12 @@ class Checkpointer:
             ),
         )
 
-    def save(self, step: int, state: Any) -> None:
+    def save(self, step: int, state: Any, meta: Optional[Dict[str, Any]] = None) -> None:
+        """Save ``state`` at ``step``. ``meta`` — the active system config
+        (``Trainer.checkpoint_meta()``: ShardingSpec axes, n_microbatches,
+        dtype) — is recorded in a JSON sidecar so a later restore can warn
+        when the live configuration differs from the one that wrote the
+        checkpoint."""
         import orbax.checkpoint as ocp
 
         from maggy_tpu import telemetry
@@ -44,13 +55,77 @@ class Checkpointer:
         t0 = time.perf_counter()
         with tel.span("checkpoint_save", step=int(step)):
             self._manager.save(int(step), args=ocp.args.StandardSave(state))
+        if meta is not None:
+            self._write_meta(int(step), meta)
         # async saves measure the blocking (dispatch) cost — the part that
         # actually steals step time
         tel.gauge("checkpoint_save_ms", (time.perf_counter() - t0) * 1e3)
 
-    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+    # ------------------------------------------------------------------ meta
+
+    def _meta_path(self, step: int) -> str:
+        return os.path.join(self.directory, _META_DIR, f"{int(step)}.json")
+
+    def _write_meta(self, step: int, meta: Dict[str, Any]) -> None:
+        from maggy_tpu.util import _jsonify
+
+        path = self._meta_path(step)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(_jsonify(meta), f, sort_keys=True)
+        except OSError:
+            pass  # metadata is advisory; never fail a save over it
+
+    def saved_meta(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """The system-config metadata recorded with ``step`` (default:
+        latest), or None for checkpoints saved without it."""
+        step = int(step) if step is not None else self.latest_step()
+        if step is None:
+            return None
+        try:
+            with open(self._meta_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _check_meta(self, step: int, expect_meta: Dict[str, Any]) -> None:
+        """Warn (never fail) when the checkpoint's recorded system config
+        disagrees with the live one on any shared key — restoring across
+        mesh shapes or microbatch settings is *supported* (adopt_state /
+        convert_pipeline_state re-place the arrays), but doing it silently
+        has burned enough people that the mismatch deserves a signal."""
+        from maggy_tpu.util import _jsonify
+
+        saved = self.saved_meta(step)
+        if not saved or not expect_meta:
+            return
+        expect = _jsonify(expect_meta)
+        diffs = [
+            f"{k}: saved={saved[k]!r} live={expect[k]!r}"
+            for k in sorted(set(saved) & set(expect))
+            if saved[k] != expect[k]
+        ]
+        if diffs:
+            warnings.warn(
+                f"checkpoint step {step} was saved under a different system "
+                f"config than the live one ({'; '.join(diffs)}); the state "
+                "will be re-placed onto the live mesh, but training dynamics "
+                "(batch/microbatch semantics) may differ",
+                stacklevel=3,
+            )
+
+    def restore(
+        self,
+        state_template: Any,
+        step: Optional[int] = None,
+        expect_meta: Optional[Dict[str, Any]] = None,
+    ) -> Any:
         """Restore onto the template's shardings (pass an abstract or concrete
-        state built by ``Trainer.make_state``)."""
+        state built by ``Trainer.make_state``). Pass the live trainer's
+        ``checkpoint_meta()`` as ``expect_meta`` to be warned when the
+        checkpoint was written under a different sharding/microbatch/dtype
+        configuration."""
         import orbax.checkpoint as ocp
 
         from maggy_tpu import telemetry
@@ -58,6 +133,8 @@ class Checkpointer:
         step = int(step) if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"No checkpoint found under {self.directory}")
+        if expect_meta is not None:
+            self._check_meta(step, expect_meta)
         with telemetry.get().span("checkpoint_restore", step=step):
             return self._manager.restore(
                 step, args=ocp.args.StandardRestore(state_template)

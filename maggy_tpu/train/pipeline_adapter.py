@@ -25,6 +25,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from maggy_tpu.util import shard_map
 from maggy_tpu.models.transformer import (
     REMAT_POLICIES,
     Decoder,
@@ -85,7 +86,7 @@ def _make_pp_tp_attention(tp: int):
         # partial-manual region that is the abstract mesh with
         # stage/data/fsdp already Manual; passing the concrete Mesh there
         # is rejected ("context mesh should match")
-        return jax.shard_map(
+        return shard_map(
             local,
             in_specs=(head_spec, head_spec, head_spec, P()),
             out_specs=head_spec,

@@ -536,6 +536,20 @@ class Trainer:
 
         return jax.jit(train_step, donate_argnums=(0,))
 
+    def checkpoint_meta(self) -> Dict[str, Any]:
+        """The active system configuration, recorded by ``Checkpointer.save``
+        alongside every state this trainer checkpoints: non-trivial mesh
+        axes, microbatch setting, and the model's compute dtype. Restores
+        compare it against the live trainer's and warn on mismatch."""
+        mesh_axes = {k: v for k, v in dict(self.mesh.shape).items() if v > 1}
+        cfg = getattr(self.model, "cfg", None)
+        return {
+            "mesh_axes": mesh_axes,
+            "num_devices": int(self.mesh.size),
+            "n_microbatches": self.n_microbatches,
+            "dtype": str(getattr(cfg, "dtype", None)) if cfg is not None else None,
+        }
+
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
         if (
             self._train_step is not None
@@ -706,7 +720,9 @@ class Trainer:
                 if checkpointer is not None and checkpoint_every and (
                     (i + 1) % checkpoint_every == 0
                 ):
-                    checkpointer.save(int(state.step), state)
+                    checkpointer.save(
+                        int(state.step), state, meta=self.checkpoint_meta()
+                    )
         finally:
             if profiling:  # loop ended/raised while a trace was active
                 jax.profiler.stop_trace()

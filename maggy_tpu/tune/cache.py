@@ -1,0 +1,113 @@
+"""Persistent tuning cache on the env seam.
+
+Winners live as JSON records under ``<env root>/tune_cache/<key>.json`` —
+through :class:`BaseEnv`, so a local directory and a ``gs://`` bucket behave
+identically (the same seam checkpoints and trial records already use). The
+key binds a record to exactly the situation it was measured in:
+
+    (model fingerprint, device topology, compute dtype, seq_len, search grid)
+
+Model fingerprint hashes the *abstract* parameter tree (every leaf's path,
+shape, dtype via ``jax.eval_shape`` — no allocation) plus the model config's
+repr when it has one; two models that would compile different programs get
+different keys. Changing the candidate grid also changes the key: a cached
+winner is only a winner *of the grid it was chosen from*.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import posixpath
+from typing import Any, Dict, Optional
+
+
+def model_fingerprint(model: Any, sample_batch: Dict[str, Any]) -> str:
+    """Stable hash of the model's abstract parameter tree + config."""
+    import jax
+
+    from maggy_tpu.train.trainer import _model_inputs
+
+    abstract = jax.eval_shape(
+        lambda rng, *ins: model.init(rng, *ins),
+        jax.random.key(0),
+        *_model_inputs(sample_batch),
+    )
+    leaves = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(abstract)[0]:
+        leaf = leaf.unbox() if hasattr(leaf, "unbox") else leaf
+        leaves.append(
+            (jax.tree_util.keystr(path), tuple(leaf.shape), str(leaf.dtype))
+        )
+    payload = json.dumps(sorted(leaves)) + repr(getattr(model, "cfg", ""))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def topology_key(devices: Optional[list] = None) -> Dict[str, Any]:
+    import jax
+
+    devs = devices if devices is not None else jax.devices()
+    d0 = devs[0]
+    return {
+        "n_devices": len(devs),
+        "platform": getattr(d0, "platform", "unknown"),
+        "device_kind": getattr(d0, "device_kind", "unknown"),
+    }
+
+
+def cache_key(
+    fingerprint: str,
+    topology: Dict[str, Any],
+    dtype: str,
+    grid: Dict[str, Any],
+) -> str:
+    payload = json.dumps(
+        {"model": fingerprint, "topology": topology, "dtype": dtype, "grid": grid},
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def alias_cache_key(fingerprint: str, topology: Dict[str, Any], dtype: str) -> str:
+    """Grid-independent pointer key: the LATEST winner for this (model,
+    topology, dtype) regardless of which grid found it. Consumers that never
+    tuned themselves (the serve CLI's ``--mesh auto``) look this up; exact
+    reproducibility consumers use the grid-bound :func:`cache_key`."""
+    payload = json.dumps(
+        {"model": fingerprint, "topology": topology, "dtype": dtype},
+        sort_keys=True,
+    )
+    return "latest-" + hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+class TuneCache:
+    """Read/write tuning records through the ambient (or given) Env."""
+
+    SUBDIR = "tune_cache"
+
+    def __init__(self, env=None):
+        if env is None:
+            from maggy_tpu.core.env import EnvSing
+
+            env = EnvSing.get_instance()
+        self.env = env
+
+    def path(self, key: str) -> str:
+        # posixpath: correct for local paths and gs:// URLs alike
+        return posixpath.join(self.env.root, self.SUBDIR, f"{key}.json")
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        path = self.path(key)
+        try:
+            if not self.env.exists(path):
+                return None
+            record = self.env.load_json(path)
+        except (OSError, ValueError):
+            return None
+        return record if isinstance(record, dict) and "best" in record else None
+
+    def put(self, key: str, record: Dict[str, Any]) -> None:
+        try:
+            self.env.dump(record, self.path(key))
+        except OSError:
+            pass  # a cold cache next run is the only consequence

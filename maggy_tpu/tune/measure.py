@@ -1,0 +1,133 @@
+"""Stage 2 — measured trials through the existing HPO machinery.
+
+The static-stage survivors become a one-parameter CATEGORICAL
+:class:`Searchspace` (the candidate index), the trial function a thin
+wrapper over ``Trainer.fit`` on synthetic batches, and the schedule the
+stock ASHA optimizer: short cheap trials at the base rung, the promising
+configurations promoted to longer measurements. There is **zero new
+distributed machinery here** — ``experiment.lagom`` runs the same driver,
+RPC plane, executors, telemetry and persistence that hyperparameter studies
+use; the "hyperparameter" just happens to be the system configuration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from maggy_tpu.tune.candidates import Candidate
+
+METRIC_KEY = "steps_per_sec"
+
+
+def make_trial_fn(
+    model: Any,
+    survivors: List[Candidate],
+    batch_fn: Callable[[int], Dict[str, Any]],
+    *,
+    make_optimizer: Callable[[], Any],
+    loss_fn: Optional[Callable] = None,
+    steps_per_unit: int = 4,
+    devices: Optional[list] = None,
+) -> Callable:
+    """The oblivious trial function: pick the candidate by index, build its
+    trainer, ``fit`` for the ASHA budget, report measured steps/sec."""
+
+    def tune_trial(hparams, reporter, budget):
+        import itertools
+
+        import jax
+
+        from maggy_tpu.tune.candidates import TunedConfig
+        from maggy_tpu.train.trainer import lm_loss_fn
+
+        cand = survivors[int(hparams["cand"])]
+        steps = max(2, int(round(float(budget or 1) * steps_per_unit)))
+        devs = devices if devices is not None else jax.devices()
+        tuned = TunedConfig.from_candidate(cand, len(devs))
+        trainer = tuned.trainer(
+            model, make_optimizer(), devices=devs,
+            loss_fn=loss_fn or lm_loss_fn,
+        )
+        data = itertools.cycle([batch_fn(cand.batch_size)])
+        state = trainer.make_state(jax.random.key(0), next(data))
+        # warmup fit: one step absorbs the XLA compile so the measured
+        # window below times steady-state steps only
+        state, _ = trainer.fit(state, data, num_steps=1)
+        state, metrics = trainer.fit(state, data, num_steps=steps)
+        sps = metrics.get(METRIC_KEY, 0.0)
+        reporter.broadcast(float(sps), step=steps)
+        reporter.log(
+            f"[tune] measured {cand.label}: {sps:.3f} steps/s over {steps} steps"
+        )
+        return {
+            METRIC_KEY: float(sps),
+            "step_time_ms": 1e3 / sps if sps else None,
+            "candidate": cand.to_dict(),
+            "steps": steps,
+        }
+
+    return tune_trial
+
+
+def measured_stage(
+    model: Any,
+    survivors: List[Candidate],
+    batch_fn: Callable[[int], Dict[str, Any]],
+    tune_cfg,
+    *,
+    make_optimizer: Callable[[], Any],
+    loss_fn: Optional[Callable] = None,
+    devices: Optional[list] = None,
+) -> Tuple[int, Dict[str, Any]]:
+    """Race the survivors under ASHA via ``experiment.lagom``. Returns
+    ``(best_candidate_index, summary)``.
+
+    Runs one experiment on the ambient env (results/telemetry land in the
+    usual experiment tree) with a single local executor — trials share the
+    host's devices, so concurrent measurement would corrupt the timings.
+    """
+    from maggy_tpu import Searchspace, experiment
+    from maggy_tpu.config import HyperparameterOptConfig
+    from maggy_tpu.optimizer import Asha
+
+    space = Searchspace(cand=("CATEGORICAL", list(range(len(survivors)))))
+    num_trials = int(tune_cfg.num_measure_trials or len(survivors))
+    cfg = HyperparameterOptConfig(
+        num_trials=num_trials,
+        optimizer=Asha(
+            reduction_factor=tune_cfg.asha_reduction_factor,
+            resource_min=tune_cfg.asha_resource_min,
+            resource_max=tune_cfg.asha_resource_max,
+            seed=tune_cfg.seed,
+        ),
+        searchspace=space,
+        optimization_key=METRIC_KEY,
+        direction="max",
+        es_policy="none",
+        name=f"{tune_cfg.name}-measure",
+        num_executors=1,
+        seed=tune_cfg.seed,
+    )
+    trial_fn = make_trial_fn(
+        model,
+        survivors,
+        batch_fn,
+        make_optimizer=make_optimizer,
+        loss_fn=loss_fn,
+        steps_per_unit=tune_cfg.steps_per_unit,
+        devices=devices,
+    )
+    result = experiment.lagom(trial_fn, cfg)
+    best = (result or {}).get("best")
+    if not best or best.get("params") is None:
+        raise RuntimeError(f"measured stage produced no best trial: {result!r}")
+    best_idx = int(best["params"]["cand"])
+    summary = {
+        "optimizer": "asha",
+        "num_trials": result.get("num_trials"),
+        "best_trial_id": best.get("trial_id"),
+        "best_steps_per_sec": best.get(METRIC_KEY),
+        "best_budget": best.get("params", {}).get("budget"),
+        "errors": result.get("errors"),
+    }
+    return best_idx, summary

@@ -131,6 +131,68 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> Optional[str]:
         return None
 
 
+def shard_map(
+    f: Callable,
+    *,
+    mesh=None,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+    axis_names=None,
+):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map`` (``check_vma``, manual axes given
+    positively via ``axis_names``); older releases (<= 0.4.x) only ship
+    ``jax.experimental.shard_map.shard_map`` where the flag is spelled
+    ``check_rep`` and partial-manual mode is the complement ``auto=`` set.
+    Every manual-collective site in the codebase (pipeline 1F1B, ring /
+    ulysses attention, sharded flash) routes through here so the whole
+    parallel tier works on both."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if axis_names is None else {"axis_names": axis_names}
+        if mesh is not None:
+            kwargs["mesh"] = mesh
+        return jax.shard_map(
+            f, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma,
+            **kwargs,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if mesh is None:
+        # mesh=None means "inherit the context mesh" on new jax; the old
+        # API requires it explicitly, so recover the ambient one
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        if mesh.empty:
+            raise TypeError(
+                "shard_map(mesh=None) needs an ambient mesh on this jax "
+                "version (enter `with mesh:` first)"
+            )
+    auto = (
+        frozenset()
+        if axis_names is None
+        else frozenset(mesh.axis_names) - frozenset(axis_names)
+    )
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, auto=auto,
+    )
+
+
+def set_mesh(mesh):
+    """Context manager making ``mesh`` ambient: ``jax.set_mesh`` on new jax,
+    the Mesh's own context manager on older releases."""
+    import jax
+
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
 def inject_kwargs(fn: Callable, available: Dict[str, Any]) -> Dict[str, Any]:
     """Inspect ``fn``'s signature and return only the kwargs it asks for.
 

@@ -132,3 +132,53 @@ def test_resume_from_missing_dir(tmp_env):
     )
     with pytest.raises(FileNotFoundError):
         experiment.lagom(lambda hparams: 1.0, cfg)
+
+
+def test_checkpoint_records_system_meta(tmp_path):
+    """Checkpointer.save records the active ShardingSpec + trainer knobs
+    (ISSUE 3 satellite); restore warns when the live config differs and is
+    silent when it matches."""
+    import warnings
+
+    cfg = DecoderConfig.tiny()
+    ctx = TrainContext.create("fsdp")
+    trainer = ctx.trainer(Decoder(cfg), optax.adamw(1e-3))
+    data = synthetic_lm_batches(cfg.vocab_size, 8, 16, seed=0)
+    batch = next(data)
+    state = trainer.make_state(jax.random.key(0), batch)
+
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), async_save=False)
+    ckpt.save(0, state, meta=trainer.checkpoint_meta())
+    ckpt.wait()
+
+    saved = ckpt.saved_meta(0)
+    assert saved is not None
+    assert saved["mesh_axes"] == {"fsdp": 8}
+    assert saved["n_microbatches"] is None
+    assert "bfloat16" in saved["dtype"]
+
+    # matching live config: no warning
+    template = trainer.make_state(jax.random.key(1), batch)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ckpt.restore(template, expect_meta=trainer.checkpoint_meta())
+
+    # mismatched live config (different mesh + microbatching): warns, still
+    # restores onto the live layout
+    ctx2 = TrainContext.create("dp")
+    trainer2 = ctx2.trainer(Decoder(cfg), optax.adamw(1e-3), n_microbatches=4)
+    template2 = trainer2.make_state(jax.random.key(2), batch)
+    with pytest.warns(UserWarning, match="different system config"):
+        restored = ckpt.restore(template2, expect_meta=trainer2.checkpoint_meta())
+    ckpt.close()
+    assert int(restored.step) == 0
+
+    # Trainer.fit's periodic saves carry the metadata automatically
+    ckpt2 = Checkpointer(str(tmp_path / "ckpt2"), async_save=False)
+    state, _ = trainer.fit(
+        state, data, num_steps=2, checkpointer=ckpt2, checkpoint_every=1
+    )
+    ckpt2.wait()
+    assert ckpt2.saved_meta() is not None
+    assert ckpt2.saved_meta()["mesh_axes"] == {"fsdp": 8}
+    ckpt2.close()

@@ -447,3 +447,27 @@ def test_no_bare_print_lint():
     assert mod.find_bare_prints("print('x')", "<s>") != []
     assert mod.find_bare_prints("import sys\nprint('x', file=sys.stderr)", "<s>") == []
     assert mod.find_bare_prints("obj.print('x')", "<s>") == []
+
+
+def test_docs_nav_lint(tmp_path):
+    """tools/check_docs_nav.py: every docs/*.md is reachable from the mkdocs
+    nav (wired into tier-1 here, alongside the bare-print lint)."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_docs_nav", os.path.join(repo, "tools", "check_docs_nav.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([repo]) == 0
+
+    # the detector itself: an orphaned page is flagged, a referenced one not
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "linked.md").write_text("# linked")
+    (tmp_path / "docs" / "orphan.md").write_text("# orphan")
+    (tmp_path / "mkdocs.yml").write_text(
+        "site_name: x\nnav:\n  - Linked: linked.md\ntheme:\n  name: mkdocs\n"
+    )
+    assert mod.orphaned_docs(str(tmp_path)) == [os.path.join("docs", "orphan.md")]
+    assert mod.main([str(tmp_path)]) == 1

@@ -1,0 +1,211 @@
+"""Autotuner acceptance (ISSUE 3): static AOT pruning via memory_analysis
+without execution, the measured stage running through the existing HPO
+driver + ASHA, a winner Trainer.fit accepts directly, and the persistent
+tuning cache serving the second invocation with zero new compiles."""
+
+import itertools
+import json
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from maggy_tpu import telemetry
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.parallel.spec import ShardingSpec
+from maggy_tpu.tune import TuneConfig, TunedConfig, cached_best, tune
+from maggy_tpu.tune import static as static_mod
+from maggy_tpu.tune.candidates import Candidate, enumerate_candidates
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh"
+)
+
+# comfortably between the ~1-2.5 MB/device estimates of the bs=8 candidates
+# and the >7 MB/device estimates of the bs=256 ones (tiny model, seq 32) —
+# the bs=256 half of the grid must prune on AOT memory analysis alone
+BUDGET_BYTES = 3_000_000
+
+
+def _model():
+    return Decoder(DecoderConfig.tiny())
+
+
+def _tune_cfg(**overrides):
+    base = dict(
+        presets=("dp", "fsdp"),
+        batch_sizes=(8, 256),
+        remat_policies=(None, "nothing"),
+        seq_len=32,
+        hbm_budget_bytes=BUDGET_BYTES,
+        measure=True,
+        steps_per_unit=2,
+        asha_resource_min=1,
+        asha_resource_max=2,
+        seed=0,
+    )
+    base.update(overrides)
+    return TuneConfig(**base)
+
+
+def _batch(batch_size, seq=32, vocab=256):
+    rng = np.random.default_rng(0)
+    return {"tokens": rng.integers(0, vocab, (batch_size, seq)).astype(np.int32)}
+
+
+def test_tune_end_to_end_with_cache(tmp_env):
+    """The acceptance scenario, one flow: >=8 candidates, >=1 AOT-pruned
+    (never executed), ASHA-measured winner through the real HPO driver,
+    winner accepted by Trainer.fit, second tune() served from cache with
+    zero new compiles."""
+    model = _model()
+    cfg = _tune_cfg()
+    tel = telemetry.Telemetry(worker="tune-test")
+    telemetry.set_current(tel)
+    try:
+        result = tune(model, cfg)
+    finally:
+        telemetry.set_current(None)
+
+    # ---- static stage: enumeration + AOT memory pruning, no execution
+    assert result.candidates >= 8
+    assert result.pruned_oom >= 1
+    assert not result.cache_hit
+    assert result.compiled == result.candidates  # every candidate AOT-analyzed
+    oom = [r for r in result.reports if r.status == "oom"]
+    ok = [r for r in result.reports if r.ok]
+    assert oom and ok
+    # pruning decisions came from memory_analysis numbers, not trial runs
+    for r in oom:
+        assert r.hbm_bytes is not None and r.hbm_bytes > BUDGET_BYTES
+    for r in ok:
+        assert r.hbm_bytes is not None and r.hbm_bytes <= BUDGET_BYTES
+    # every oversized batch was caught statically
+    assert {r.candidate.batch_size for r in oom} == {256}
+
+    # ---- measured stage ran through the existing HPO driver with ASHA
+    assert result.measured is not None
+    assert result.measured["optimizer"] == "asha"
+    assert result.measured["num_trials"] >= len(ok)
+    assert result.measured["errors"] == 0
+    # the driver persisted a real experiment record naming the controller
+    exp_records = []
+    for dirpath, _dirnames, filenames in os.walk(tmp_env.root):
+        if "experiment.json" in filenames:
+            with open(os.path.join(dirpath, "experiment.json")) as f:
+                exp_records.append(json.load(f))
+    assert any(rec.get("optimizer") == "Asha" for rec in exp_records)
+
+    # pruned candidates never reached the measured stage: the winner is a
+    # static-stage survivor
+    assert result.best.source == "measured"
+    assert result.best.batch_size in {r.candidate.batch_size for r in ok}
+    assert result.best.steps_per_sec and result.best.steps_per_sec > 0
+
+    # ---- telemetry gauges
+    gauges = tel.snapshot().get("gauges", {})
+    assert gauges.get("tune.candidates") == result.candidates
+    assert gauges.get("tune.pruned_oom") == result.pruned_oom
+    assert gauges.get("tune.best_step_time", 0) > 0
+
+    # ---- the winner builds a trainer Trainer.fit accepts directly
+    trainer = result.best.trainer(model, optax.adamw(1e-3))
+    data = itertools.cycle([_batch(result.best.batch_size)])
+    state = trainer.make_state(jax.random.key(0), next(data))
+    state, metrics = trainer.fit(state, data, num_steps=2)
+    assert np.isfinite(metrics["loss"])
+
+    # ---- second invocation: served from the persistent cache, no compiles
+    compiles_before = static_mod.COMPILE_COUNT
+    result2 = tune(model, cfg)
+    assert result2.cache_hit
+    assert static_mod.COMPILE_COUNT == compiles_before  # zero new compiles
+    assert result2.compiled == 0
+    assert result2.best.to_dict() == result.best.to_dict()
+    assert result2.candidates == result.candidates
+    assert result2.pruned_oom == result.pruned_oom
+
+    # grid-independent alias: consumers that never tuned (serve --mesh auto)
+    # find the same winner
+    alias = cached_best(model)
+    assert alias is not None
+    assert alias.to_dict() == result.best.to_dict()
+
+
+def test_enumerate_candidates_drops_infeasible():
+    """Cheap validity checks happen before any compile: indivisible batches
+    vanish, microbatch options only apply to pp meshes, pp x sp never
+    enumerates."""
+    cfg = TuneConfig(
+        presets=("dp", "fsdp", ShardingSpec(pp=2, sp=2, dp=2)),
+        batch_sizes=(8, 12),  # 12 % 8 != 0 -> dropped on 8-device dp/fsdp
+        microbatches=(2, 4),
+        seq_len=16,
+    )
+    cands = enumerate_candidates(cfg, 8)
+    assert cands, "dp/fsdp bs=8 candidates must survive"
+    assert all(c.batch_size == 8 for c in cands)
+    # non-pp meshes collapse the microbatch axis to None (no duplicates)
+    assert all(c.n_microbatches is None for c in cands)
+    # the pp x sp spec is invalid by construction and never enumerated
+    assert all(
+        not (isinstance(c.preset, ShardingSpec) and c.preset.sp > 1)
+        for c in cands
+    )
+
+
+def test_static_report_marks_infeasible_without_raising():
+    """A candidate the Trainer cannot even build reports 'infeasible'
+    instead of sinking the whole tune run."""
+    model = _model()
+    report = static_mod.analyze_candidate(
+        model,
+        Candidate(preset="fsdp", batch_size=6),  # 6 rows unshardable 8-way
+        _batch(6, seq=16),
+        optimizer=optax.adamw(1e-3),
+        budget_bytes=None,
+    )
+    assert report.status in ("infeasible", "ok")
+    if report.status == "infeasible":
+        assert report.reason
+
+
+def test_tuned_config_roundtrip_and_trainer_kwargs():
+    tuned = TunedConfig(
+        spec=ShardingSpec(fsdp=8),
+        batch_size=16,
+        n_microbatches=None,
+        remat_policy="nothing",
+        source="measured",
+        steps_per_sec=12.5,
+        step_time_ms=80.0,
+    )
+    back = TunedConfig.from_dict(json.loads(json.dumps(tuned.to_dict())))
+    assert back == tuned
+    trainer = tuned.trainer(_model(), optax.adamw(1e-3))
+    # remat policy applied onto the model's config
+    assert trainer.model.cfg.remat and trainer.model.cfg.remat_policy == "nothing"
+    assert dict(trainer.mesh.shape)["fsdp"] == 8
+
+
+def test_monitor_renders_tune_gauges():
+    """The dashboard's telemetry panel shows autotune progress."""
+    from maggy_tpu.monitor import _telemetry_lines
+
+    status = {
+        "telemetry": {
+            "0": {
+                "gauges": {
+                    "tune.candidates": 8.0,
+                    "tune.pruned_oom": 4.0,
+                    "tune.best_step_time": 16.9,
+                }
+            }
+        }
+    }
+    lines = "\n".join(_telemetry_lines(status, width=78))
+    assert "tune 8 cand" in lines
+    assert "oom-pruned 4" in lines
+    assert "best 16.9ms/step" in lines

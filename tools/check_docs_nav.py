@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Lint: every ``docs/*.md`` page must appear in the mkdocs nav.
+
+A page missing from ``mkdocs.yml``'s ``nav:`` builds fine but is
+unreachable from the rendered site — docs rot silently (the exact failure
+mode that orphaned earlier satellite pages). The nav is parsed with a
+line regex rather than a YAML library so the lint runs on the bare runtime
+image (pyyaml is not vendored).
+
+Usage: ``python tools/check_docs_nav.py [repo_root]`` — exits nonzero
+listing every orphaned page. Wired into the tier-1 run via
+``tests/test_telemetry.py`` alongside ``check_no_bare_print.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# "  - Title: file.md" (any indent level, quoted or not)
+_NAV_ENTRY = re.compile(r"^\s*-\s+(?:[^:]+:\s*)?['\"]?([\w./-]+\.md)['\"]?\s*$")
+
+
+def nav_pages(mkdocs_yml: str):
+    """Every .md path referenced from the nav section of mkdocs.yml."""
+    pages = set()
+    in_nav = False
+    with open(mkdocs_yml, encoding="utf-8") as f:
+        for line in f:
+            stripped = line.rstrip("\n")
+            if re.match(r"^nav\s*:", stripped):
+                in_nav = True
+                continue
+            if in_nav:
+                # nav block ends at the next top-level key
+                if stripped and not stripped[0].isspace() and not stripped.startswith("-"):
+                    break
+                m = _NAV_ENTRY.match(stripped)
+                if m:
+                    pages.add(m.group(1))
+    return pages
+
+
+def orphaned_docs(repo_root: str):
+    """docs/*.md files absent from the mkdocs nav."""
+    mkdocs_yml = os.path.join(repo_root, "mkdocs.yml")
+    docs_dir = os.path.join(repo_root, "docs")
+    if not os.path.isfile(mkdocs_yml) or not os.path.isdir(docs_dir):
+        return []
+    pages = nav_pages(mkdocs_yml)
+    missing = []
+    for name in sorted(os.listdir(docs_dir)):
+        if name.endswith(".md") and name not in pages:
+            missing.append(os.path.join("docs", name))
+    return missing
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    repo = args[0] if args else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    missing = orphaned_docs(repo)
+    for path in missing:
+        print(
+            f"{path}: not referenced from mkdocs.yml nav — add a nav entry "
+            "or the page is unreachable from the docs site",
+            file=sys.stderr,
+        )
+    if missing:
+        print(f"{len(missing)} orphaned docs page(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
