@@ -26,6 +26,10 @@ class AblationConfig(LagomConfig):
         sharding: Optional[Any] = None,
         driver_addr: Optional[str] = None,
         worker_timeout: float = 600.0,
+        trial_retries: int = 2,
+        retry_backoff: float = 0.5,
+        quarantine_after: int = 3,
+        quarantine_cooldown: float = 300.0,
     ):
         super().__init__(name, description, hb_interval)
         if direction not in ("max", "min"):
@@ -42,3 +46,9 @@ class AblationConfig(LagomConfig):
         self.sharding = sharding
         self.driver_addr = driver_addr
         self.worker_timeout = float(worker_timeout)
+        # trial-loss retry/quarantine policy, forwarded to the HPO scheduling
+        # machinery the ablation driver reuses (see HyperparameterOptConfig)
+        self.trial_retries = int(trial_retries)
+        self.retry_backoff = float(retry_backoff)
+        self.quarantine_after = int(quarantine_after)
+        self.quarantine_cooldown = float(quarantine_cooldown)

@@ -42,6 +42,7 @@ class DistributedConfig(LagomConfig):
         worker_timeout: float = 1800.0,
         coordinator_port: Optional[int] = None,
         evaluator: bool = False,
+        max_restarts: int = 0,
     ):
         """:param module: a flax ``nn.Module`` class, instance, or zero-arg factory —
             the analogue of the reference's torch module class argument
@@ -107,6 +108,17 @@ class DistributedConfig(LagomConfig):
         # training group; the train_fn sees ctx.role == "evaluator" and its
         # outputs land under result["evaluator"] instead of the training mean.
         self.evaluator = bool(evaluator)
+        # elastic restart budget (docs/resilience.md): on a TRANSIENT worker
+        # death (worker/host loss — never a train_fn exception) the driver
+        # re-runs the registration barrier + EXEC_CONFIG exchange for the lost
+        # partition and relaunches its train_fn, which picks up the latest
+        # checkpoint via Trainer.fit(resume="auto"). 0 (default) keeps the
+        # fail-fast abort. Env override: MAGGY_TPU_MAX_RESTARTS.
+        if max_restarts == 0 and os.environ.get("MAGGY_TPU_MAX_RESTARTS"):
+            max_restarts = int(os.environ["MAGGY_TPU_MAX_RESTARTS"])
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        self.max_restarts = int(max_restarts)
 
     def resolve_sharding(self, num_devices: int) -> ShardingSpec:
         if isinstance(self.sharding, ShardingSpec):

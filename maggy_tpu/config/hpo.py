@@ -42,6 +42,10 @@ class HyperparameterOptConfig(LagomConfig):
         sharding: Optional[Any] = None,
         driver_addr: Optional[str] = None,
         worker_timeout: float = 600.0,
+        trial_retries: int = 2,
+        retry_backoff: float = 0.5,
+        quarantine_after: int = 3,
+        quarantine_cooldown: float = 300.0,
     ):
         """:param num_trials: total trials to run (pruner may override, as in the
             reference optimization_driver.py:88-93).
@@ -70,9 +74,22 @@ class HyperparameterOptConfig(LagomConfig):
             same script with MAGGY_TPU_ROLE=worker adds trial capacity.
         :param worker_timeout: pod mode — seconds of silence after which a
             registered remote worker is presumed dead: its in-flight trial is
-            marked ERROR and freed, and the experiment CONTINUES on the
-            remaining capacity (a respawned worker re-registers and serves
-            again — ``python -m maggy_tpu.run --respawn``).
+            freed and requeued (see ``trial_retries``), and the experiment
+            CONTINUES on the remaining capacity (a respawned worker
+            re-registers and serves again — ``python -m maggy_tpu.run
+            --respawn``).
+        :param trial_retries: how many times a trial lost to a TRANSIENT
+            failure (worker death / RPC loss) is requeued before it is marked
+            ERROR for good. Deterministic failures — an exception raised by
+            the train_fn — never retry (docs/resilience.md). Env override:
+            ``MAGGY_TPU_TRIAL_RETRIES``.
+        :param retry_backoff: base seconds of the exponential (jittered)
+            backoff before a requeued trial becomes schedulable again. Env
+            override: ``MAGGY_TPU_RETRY_BACKOFF``.
+        :param quarantine_after: consecutive lost trials after which a worker
+            is quarantined out of scheduling (flaky host protection).
+        :param quarantine_cooldown: seconds a quarantined worker sits out
+            before re-entering on probation.
         """
         super().__init__(name, description, hb_interval)
         if not isinstance(num_trials, int) or num_trials <= 0:
@@ -103,3 +120,9 @@ class HyperparameterOptConfig(LagomConfig):
         self.sharding = sharding
         self.driver_addr = driver_addr
         self.worker_timeout = float(worker_timeout)
+        if trial_retries < 0:
+            raise ValueError("trial_retries must be >= 0")
+        self.trial_retries = int(trial_retries)
+        self.retry_backoff = float(retry_backoff)
+        self.quarantine_after = int(quarantine_after)
+        self.quarantine_cooldown = float(quarantine_cooldown)

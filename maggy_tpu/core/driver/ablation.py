@@ -81,6 +81,10 @@ class AblationDriver(HyperparameterOptDriver):
             sharding=config.sharding,
             driver_addr=getattr(config, "driver_addr", None),
             worker_timeout=getattr(config, "worker_timeout", 600.0),
+            trial_retries=getattr(config, "trial_retries", 2),
+            retry_backoff=getattr(config, "retry_backoff", 0.5),
+            quarantine_after=getattr(config, "quarantine_after", 3),
+            quarantine_cooldown=getattr(config, "quarantine_cooldown", 300.0),
         )
         super().__init__(hpo_config, app_id, run_id)
 

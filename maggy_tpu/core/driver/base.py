@@ -229,6 +229,10 @@ class Driver(ABC):
         return list(range(self.num_executors))
 
     def _launch_executors(self, train_fn: Callable) -> None:
+        # kept for elastic respawn (_respawn_executor): a replacement worker
+        # for a dead slot needs the same train_fn/devices wiring
+        self._train_fn = train_fn
+        self._local_pids = set(self._local_partitions())
         groups = self._device_groups()
         for pid in self._local_partitions():
             devices = groups[pid % len(groups)] if groups else []
@@ -240,13 +244,35 @@ class Driver(ABC):
             self._worker_threads.append(t)
             t.start()
 
+    def _respawn_executor(self, partition_id: int) -> None:
+        """Relaunch one local executor slot after an absorbed worker death
+        (digestion thread; see ``_on_worker_death``). The replacement builds
+        a fresh RPC client — its new attempt nonce makes the re-REG read as
+        a worker restart, which is exactly what it is."""
+        groups = self._device_groups()
+        devices = groups[partition_id % len(groups)] if groups else []
+        fn = self._executor_fn(self._train_fn, partition_id, devices)
+        t = threading.Thread(
+            target=self._worker_wrapper, args=(fn, partition_id),
+            name=f"maggy-executor-{partition_id}-respawn", daemon=True,
+        )
+        self._worker_threads.append(t)
+        t.start()
+        self.log(f"Executor {partition_id} respawned")
+
     def _device_groups(self) -> List[list]:
         return device_groups(getattr(self.config, "devices_per_trial", 1))
 
     def _worker_wrapper(self, fn: Callable, partition_id: int) -> None:
         try:
             fn()
-        except BaseException as e:  # noqa: BLE001 - worker death aborts the experiment
+        except BaseException as e:  # noqa: BLE001 - unabsorbed death aborts the experiment
+            if self._on_worker_death(partition_id, e):
+                self.log(
+                    f"Executor {partition_id} died ({type(e).__name__}: {e}); "
+                    "absorbed by the resilience policy"
+                )
+                return
             with self.lock:
                 if self.exception is None:
                     self.exception = e
@@ -255,6 +281,14 @@ class Driver(ABC):
             )
             self.abort.set()
             self.experiment_done.set()
+
+    def _on_worker_death(self, partition_id: int, exc: BaseException) -> bool:
+        """Hook for resilient drivers: return True when the death was
+        absorbed (trial requeued / elastic restart queued) so the experiment
+        continues; False (default) aborts it. Runs on the dying worker's
+        thread — implementations must only enqueue work for the digestion
+        thread, never touch controller state directly."""
+        return False
 
     def _await_completion(self) -> None:
         for t in self._worker_threads:
@@ -385,6 +419,12 @@ class Driver(ABC):
             "elapsed_s": time.time() - self.job_start if self.job_start else None,
         }
         snaps = dict(self.worker_telemetry)  # event-loop-thread read; snapshot
+        if self.telemetry.active:
+            # the driver's own recorder rides along: resilience counters
+            # (requeues, quarantines, restarts) live here, not on any worker
+            drv = self.telemetry.snapshot()
+            if drv.get("counters") or drv.get("gauges"):
+                snaps = {**snaps, "driver": drv}
         if snaps:
             out["telemetry"] = snaps
         return out

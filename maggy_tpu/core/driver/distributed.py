@@ -54,6 +54,12 @@ class DistributedTrainingDriver(Driver):
         self._coordinator = None  # host:port of worker 0, filled at registration
         self._last_seen: Dict[int, float] = {}  # partition -> last contact ts
         self._final_pids: set = set()
+        # elastic restart (docs/resilience.md): a TRANSIENT worker death
+        # consumes one restart slot and relaunches that partition — the
+        # replacement re-runs registration + EXEC_CONFIG and its train_fn
+        # resumes from the latest checkpoint via fit(resume="auto")
+        self.max_restarts = int(getattr(config, "max_restarts", 0))
+        self._restarts = 0
         # pod mode: remote hosts run their own copy of the script and connect
         # as workers (core/pod.py); this driver launches only partition 0
         from maggy_tpu.core.pod import driver_address
@@ -145,9 +151,50 @@ class DistributedTrainingDriver(Driver):
 
     # ------------------------------------------------------------------ digestion
 
+    def _on_worker_death(self, partition_id: int, exc: BaseException) -> bool:
+        """Local worker-thread death: absorb TRANSIENT failures while restart
+        budget remains (runs on the dying thread — only enqueues)."""
+        from maggy_tpu.resilience import TRANSIENT, classify_failure
+
+        if self.experiment_done.is_set() or classify_failure(exc) != TRANSIENT:
+            return False
+        with self.lock:
+            if self._restarts >= self.max_restarts:
+                return False
+            self._restarts += 1
+            nth = self._restarts
+        self.telemetry.count("resilience.dist_restarts")
+        self.server.enqueue(
+            {
+                "type": "_RESTART",
+                "partition_id": partition_id,
+                "error": f"{type(exc).__name__}: {exc}",
+                "restart": nth,
+            }
+        )
+        return True
+
+    def _digest_restart(self, msg: Dict[str, Any]) -> None:
+        pid = msg["partition_id"]
+        self.log(
+            f"Worker {pid} died ({msg['error']}); elastic restart "
+            f"{msg['restart']}/{self.max_restarts}: re-running registration "
+            f"+ EXEC_CONFIG for partition {pid} and relaunching its train_fn "
+            "from the latest checkpoint"
+        )
+        with self.lock:
+            # the partition's previous FINAL (if any) is void — its rerun
+            # reports the authoritative one
+            self._finals = [m for m in self._finals if m["partition_id"] != pid]
+            self._final_pids.discard(pid)
+            self._last_seen.pop(pid, None)
+        self._respawn_executor(pid)
+
     def _handle_message(self, msg: Dict[str, Any]) -> None:
         verb = msg.get("type")
-        if verb == "METRIC":
+        if verb == "_RESTART":
+            self._digest_restart(msg)
+        elif verb == "METRIC":
             logs = msg.get("logs") or []
             if logs:
                 self.add_executor_logs(logs)
@@ -205,6 +252,8 @@ class DistributedTrainingDriver(Driver):
             base.update(
                 workers_done=len(self._final_pids),
                 evaluator_partition=self.evaluator_partition,
+                restarts=self._restarts,
+                max_restarts=self.max_restarts,
                 last_seen={
                     str(pid): round(time.time() - ts, 1)
                     for pid, ts in self._last_seen.items()
@@ -258,6 +307,30 @@ class DistributedTrainingDriver(Driver):
                     ]
                 if stale:
                     with self.lock:
+                        budget_left = self.max_restarts - self._restarts
+                        if budget_left >= len(stale):
+                            # elastic window: charge the budget, forget the
+                            # dead registrations, and keep waiting — the
+                            # respawned hosts (supervisor/launcher) re-register
+                            # and resume from the latest checkpoint
+                            self._restarts += len(stale)
+                            for pid in stale:
+                                self._last_seen.pop(pid, None)
+                            restarts = self._restarts
+                        else:
+                            restarts = None
+                    if restarts is not None:
+                        self.telemetry.count(
+                            "resilience.dist_restarts", len(stale)
+                        )
+                        self.log(
+                            f"Pod worker(s) {stale} silent > {timeout:.0f}s; "
+                            f"elastic restart window open "
+                            f"({restarts}/{self.max_restarts} restarts used) "
+                            "— awaiting re-registration"
+                        )
+                        continue
+                    with self.lock:
                         if self.exception is None:
                             self.exception = RuntimeError(
                                 f"Pod worker(s) {stale} silent for more than "
@@ -267,7 +340,21 @@ class DistributedTrainingDriver(Driver):
                     self.experiment_done.set()
                     return
         else:
-            self.experiment_done.wait(timeout=60)
+            # local mode: wait for digestion to aggregate the finals. A dead
+            # executor may be about to come back via elastic restart, so the
+            # 60s grace clock only runs while NO executor thread is alive —
+            # a respawned worker (which may train for minutes) resets it.
+            grace_deadline = None
+            while not self.experiment_done.wait(timeout=0.5):
+                if self.abort.is_set():
+                    return
+                if any(t.is_alive() for t in self._worker_threads):
+                    grace_deadline = None
+                    continue
+                if grace_deadline is None:
+                    grace_deadline = time.time() + 60
+                elif time.time() > grace_deadline:
+                    return
 
     def _device_groups(self) -> List[list]:
         # one worker per process; with several local workers each leases a

@@ -26,6 +26,7 @@ from maggy_tpu.core.driver.base import Driver, device_groups
 from maggy_tpu.core.executors.trial import trial_executor_fn
 from maggy_tpu.optimizer import IDLE, get_earlystop, get_optimizer
 from maggy_tpu.optimizer.gridsearch import GridSearch
+from maggy_tpu.resilience import QuarantineTracker, RetryPolicy
 from maggy_tpu.trial import Trial
 
 
@@ -78,6 +79,20 @@ class HyperparameterOptDriver(Driver):
         self._es_last_check = time.time()
         self._optimizer_exhausted = False
         self._maybe_idle: set = set()
+
+        # resilience (docs/resilience.md): trials lost to TRANSIENT failures
+        # (worker death / RPC loss) are requeued with a per-trial retry budget
+        # and jittered exponential backoff instead of terminal ERROR; a worker
+        # whose consecutive trials keep dying is quarantined out of
+        # _try_assign for a cooldown. All state below is digestion-thread
+        # owned (reads under self.lock where the STATUS path also looks).
+        self.retry_policy = RetryPolicy.from_config(config)
+        self.quarantine = QuarantineTracker(
+            threshold=getattr(config, "quarantine_after", 3),
+            cooldown=getattr(config, "quarantine_cooldown", 300.0),
+        )
+        self._retry_queue: List[tuple] = []  # (ready_ts, Trial), unordered
+        self._stashed_suggestion = None  # probe result awaiting a worker
 
         # pod mode (reference parity: Spark runs trial executors on cluster
         # hosts, spark_driver.py:136-145): remote hosts running the same
@@ -233,6 +248,40 @@ class HyperparameterOptDriver(Driver):
             self._digest_metric(msg)
         elif verb == "FINAL":
             self._digest_final(msg)
+        elif verb == "_WORKER_LOST":
+            self._digest_worker_lost(msg)
+
+    def _on_worker_death(self, partition_id: int, exc: BaseException) -> bool:
+        """A local executor thread died. TRANSIENT failures (worker kill /
+        RPC loss) are absorbed: the in-flight trial is requeued and the
+        worker slot respawned on the digestion thread. Deterministic
+        failures keep the fail-fast abort."""
+        from maggy_tpu.resilience import TRANSIENT, classify_failure
+
+        if self.experiment_done.is_set() or classify_failure(exc) != TRANSIENT:
+            return False
+        self.telemetry.count("resilience.worker_deaths")
+        self.server.enqueue(
+            {
+                "type": "_WORKER_LOST",
+                "partition_id": partition_id,
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+        )
+        return True
+
+    def _digest_worker_lost(self, msg) -> None:
+        pid = msg["partition_id"]
+        self.log(f"Executor {pid} died ({msg['error']}); recovering")
+        self._lose_assignment(pid, f"executor {pid} died: {msg['error']}")
+        self._last_seen.pop(pid, None)
+        self._maybe_idle.discard(pid)
+        # respawn lost LOCAL capacity (remote pod workers come back through
+        # their own supervisor, `maggy_tpu.run --respawn`) — unless the slot
+        # is quarantined, in which case it stays down for the cooldown
+        if pid in getattr(self, "_local_pids", ()) and not self.quarantine.is_quarantined(pid):
+            self._respawn_executor(pid)
+        self._maybe_finish()
 
     def _digest_reg(self, msg) -> None:
         pid = msg["partition_id"]
@@ -243,20 +292,50 @@ class HyperparameterOptDriver(Driver):
         self._try_assign(pid)
 
     def _lose_assignment(self, pid: int, reason: str) -> None:
-        """Free ``pid``'s in-flight trial: mark ERROR, persist, unassign.
-        Digestion thread only (controller-adjacent state)."""
+        """Free ``pid``'s in-flight trial after a TRANSIENT loss (worker
+        death / re-registration / RPC silence — the only paths that reach
+        here; a train_fn exception arrives as a FINAL error instead and
+        fails fast). The trial is requeued with backoff while its retry
+        budget lasts; only an exhausted budget marks ERROR. Digestion thread
+        only (controller-adjacent state)."""
         assignment = self.server.reservations.get_assignment(pid)
         if assignment is None:
             return
         with self.lock:
             lost = self.trial_store.pop(assignment, None)
-            if lost is not None:
-                lost.error()
-                self.final_store.append(lost)
-        if lost is not None:
-            self._persist_trial(lost)
-            self.log(f"Trial {assignment} lost ({reason}); marked ERROR")
         self.server.reservations.assign_trial(pid, None)
+        if lost is None:
+            return
+        if self.quarantine.record_failure(pid):
+            self.telemetry.count("resilience.workers_quarantined")
+            self.log(
+                f"Executor {pid} quarantined: {self.quarantine.threshold} "
+                f"consecutive trials died on it (cooldown "
+                f"{self.quarantine.cooldown:.0f}s)"
+            )
+        retries = int(lost.info_dict.get("retries", 0))
+        if retries < self.retry_policy.max_retries:
+            delay = self.retry_policy.delay(retries)
+            lost.reset_for_retry()
+            lost.info_dict["retries"] = retries + 1
+            with self.lock:
+                self._retry_queue.append((time.time() + delay, lost))
+            self.telemetry.count("resilience.trials_requeued")
+            self.log(
+                f"Trial {assignment} lost ({reason}); requeued — retry "
+                f"{retries + 1}/{self.retry_policy.max_retries} in {delay:.1f}s"
+            )
+        else:
+            lost.error()
+            with self.lock:
+                self.final_store.append(lost)
+            self._persist_trial(lost)
+            self.telemetry.count("resilience.trials_exhausted")
+            self.log(
+                f"Trial {assignment} lost ({reason}); retry budget "
+                f"({self.retry_policy.max_retries}) exhausted — marked ERROR"
+            )
+            self._maybe_finish()
 
     def _liveness_sweep(self) -> None:
         """Pod mode: a registered worker silent past worker_timeout is
@@ -279,12 +358,10 @@ class HyperparameterOptDriver(Driver):
                 "remaining workers"
             )
             self._lose_assignment(pid, f"executor {pid} presumed dead")
-        # a dead worker must not strand completion once the budget is spent
-        if self._optimizer_exhausted:
-            with self.lock:
-                in_flight = len(self.trial_store)
-            if in_flight == 0 and not self.experiment_done.is_set():
-                self._finish_experiment()
+        # a dead worker must never strand completion — even before budget
+        # exhaustion (_maybe_finish probes the controller directly instead of
+        # waiting for a worker GET that may never come)
+        self._maybe_finish()
 
     def _digest_metric(self, msg) -> None:
         trial_id, metric, step = msg.get("trial_id"), msg.get("metric"), msg.get("step")
@@ -351,6 +428,9 @@ class HyperparameterOptDriver(Driver):
         with self.lock:
             self.final_store.append(trial)
         self._persist_trial(trial)
+        # any completed trial (even an errored one — the WORKER survived to
+        # report it) clears the worker's death streak
+        self.quarantine.record_success(pid)
         # reservation already cleared synchronously by _final_callback
         self.log(
             f"Trial {trial_id} {trial.status} metric={trial.final_metric} "
@@ -362,9 +442,11 @@ class HyperparameterOptDriver(Driver):
         if self.pod_mode:
             self._liveness_sweep()
         # retry partitions that previously got IDLE (reference
-        # optimization_driver.py:542-568 debounced retries)
+        # optimization_driver.py:542-568 debounced retries) — these also pick
+        # up requeued trials whose backoff has elapsed
         for pid in list(self._maybe_idle):
             self._try_assign(pid)
+        self._maybe_finish()
 
     def _try_assign(self, pid: int) -> None:
         # THREADING INVARIANT (round-1 verdict weak #6): the controller
@@ -377,9 +459,30 @@ class HyperparameterOptDriver(Driver):
             return
         if self.server.reservations.get_assignment(pid) is not None:
             return
+        if self.quarantine.is_quarantined(pid):
+            # no work for a quarantined worker; keep it on the tick radar so
+            # it gets reconsidered once the cooldown releases it
+            self._maybe_idle.add(pid)
+            return
+        # requeued trials outrank fresh suggestions: their budget is already
+        # spent and the controller has observed nothing for them yet
+        now = time.time()
+        retry = None
+        with self.lock:
+            for i, (ready_ts, trial) in enumerate(self._retry_queue):
+                if ready_ts <= now:
+                    retry = self._retry_queue.pop(i)[1]
+                    break
+        if retry is not None:
+            self._assign(pid, retry, note="retry")
+            return
         with self.lock:
             finished = self.final_store[-1] if self.final_store else None
             done_ids = {t.trial_id for t in self.final_store}
+            stash, self._stashed_suggestion = self._stashed_suggestion, None
+        if stash is not None and stash.trial_id not in done_ids:
+            self._assign(pid, stash)
+            return
         suggestion = self.controller.get_suggestion(finished)
         # resumed experiments: skip suggestions that already finalized in the
         # previous run (bounded — each skip consumes the controller's budget)
@@ -391,25 +494,63 @@ class HyperparameterOptDriver(Driver):
                 break
             suggestion = self.controller.get_suggestion(None)
         if isinstance(suggestion, Trial):
-            suggestion.schedule(pid)
-            with self.lock:
-                self.trial_store[suggestion.trial_id] = suggestion
-            self.server.reservations.assign_trial(pid, suggestion.trial_id)
-            self._maybe_idle.discard(pid)
-            self._controller_log(
-                f"{suggestion.info_dict.get('sample_type', '?')} trial "
-                f"{suggestion.trial_id} -> executor {pid} "
-                f"budget={suggestion.params.get('budget')}"
-            )
+            self._assign(pid, suggestion)
         elif suggestion == IDLE:
             self._maybe_idle.add(pid)
         else:  # None: optimizer exhausted
             self._optimizer_exhausted = True
-            self._maybe_idle.discard(pid)
             with self.lock:
-                in_flight = len(self.trial_store)
-            if in_flight == 0:
-                self._finish_experiment()
+                pending = len(self._retry_queue)
+            if pending:
+                # a requeued trial still needs this worker once its backoff
+                # elapses — keep it on the tick radar
+                self._maybe_idle.add(pid)
+            else:
+                self._maybe_idle.discard(pid)
+            self._maybe_finish()
+
+    def _assign(self, pid: int, trial: Trial, note: str = "") -> None:
+        """Hand ``trial`` to executor ``pid`` (digestion thread only)."""
+        trial.schedule(pid)
+        with self.lock:
+            self.trial_store[trial.trial_id] = trial
+        self.server.reservations.assign_trial(pid, trial.trial_id)
+        self._maybe_idle.discard(pid)
+        kind = note or trial.info_dict.get("sample_type", "?")
+        self._controller_log(
+            f"{kind} trial {trial.trial_id} -> executor {pid} "
+            f"budget={trial.params.get('budget')}"
+        )
+
+    def _maybe_finish(self) -> None:
+        """Complete the experiment when no more work can or will be
+        scheduled. Fixes the stranded-completion edge: the last worker dying
+        *before* budget exhaustion used to leave nobody to poll the
+        controller, hanging ``_await_completion`` forever — with nothing in
+        flight and nothing queued, probe the controller directly; a Trial it
+        returns is stashed for the next ``_try_assign``. Digestion thread
+        only (calls into the controller)."""
+        if self.experiment_done.is_set():
+            return
+        with self.lock:
+            in_flight = len(self.trial_store)
+            pending = len(self._retry_queue)
+            stash = self._stashed_suggestion
+            finished = self.final_store[-1] if self.final_store else None
+        if in_flight or pending or stash is not None:
+            return
+        if not self._optimizer_exhausted:
+            suggestion = self.controller.get_suggestion(finished)
+            if isinstance(suggestion, Trial):
+                with self.lock:
+                    self._stashed_suggestion = suggestion
+                return
+            if suggestion == IDLE:
+                # nothing in flight yet the controller is waiting — transient
+                # (e.g. a pruner mid-decision); probe again next tick
+                return
+            self._optimizer_exhausted = True
+        self._finish_experiment()
 
     def _finish_experiment(self) -> None:
         self._update_result()
@@ -530,7 +671,11 @@ class HyperparameterOptDriver(Driver):
                 ),
                 best=best,
                 controller_log=list(self._controller_tail),
+                trials_requeued=len(self._retry_queue),
             )
+            quarantined = self.quarantine.snapshot()
+            if quarantined:
+                base.update(quarantined=quarantined)
             if self.pod_mode:
                 # dict() snapshot: the digestion thread's liveness sweep pops
                 # entries concurrently with this event-loop-thread iteration

@@ -21,7 +21,7 @@ from typing import Callable, Optional
 
 from maggy_tpu import util
 from maggy_tpu.core.env import EnvSing
-from maggy_tpu.exceptions import EarlyStopException
+from maggy_tpu.exceptions import EarlyStopException, WorkerLost
 from maggy_tpu.reporter import Reporter
 
 
@@ -119,6 +119,11 @@ def dist_executor_fn(
             except EarlyStopException as e:
                 metric = e.metric
                 outputs = {"metric": metric}
+            except WorkerLost:
+                # worker death (preemption / chaos kill): no FINAL — the
+                # executor dies and the driver's elastic-restart path
+                # (DistributedConfig(max_restarts=...)) takes over
+                raise
             except Exception as e:  # noqa: BLE001
                 error = f"{type(e).__name__}: {e}"
                 reporter.log(f"Distributed worker {partition_id} failed:\n{traceback.format_exc()}")

@@ -23,7 +23,7 @@ from typing import Any, Callable, Dict, Optional
 from maggy_tpu import constants, util
 from maggy_tpu.core import rpc
 from maggy_tpu.core.env import EnvSing
-from maggy_tpu.exceptions import EarlyStopException
+from maggy_tpu.exceptions import EarlyStopException, WorkerLost
 from maggy_tpu.reporter import Reporter, capture_prints
 
 # keys stripped from trial params before they reach the train_fn as hparams
@@ -167,6 +167,12 @@ def trial_executor_fn(
             metric = e.metric if e.metric is not None else reporter.get_metric()
             outputs = {config.optimization_key: metric}
             reporter.log(f"Trial {trial_id} early-stopped at metric {metric}")
+        except WorkerLost:
+            # worker death (preemption / chaos kill), not a trial error: no
+            # FINAL goes out — the executor dies with it and the driver
+            # requeues the in-flight trial and respawns/quarantines the slot
+            tb._unregister()
+            raise
         except Exception as e:  # noqa: BLE001 - errored trial, not a dead worker
             error = f"{type(e).__name__}: {e}"
             reporter.log(f"Trial {trial_id} failed:\n{traceback.format_exc()}")

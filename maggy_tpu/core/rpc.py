@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import json
 import queue
+import random
 import secrets as secrets_mod
 import socket
 import struct
@@ -38,8 +39,20 @@ from maggy_tpu.exceptions import (
     ReservationTimeoutError,
     RpcError,
 )
+from maggy_tpu.resilience import chaos as chaos_mod
 
 _LEN = struct.Struct(">I")
+
+
+def _retry_delay(attempt: int) -> float:
+    """Reconnect/retry backoff: linear base growth with a ±50% random spread.
+    Without the jitter a whole pod of workers that lost the driver at the
+    same instant (driver GC pause, network blip) would sleep identical
+    delays and reconnect in lockstep, hammering the recovered server with a
+    synchronized thundering herd. Base and retry count take env overrides
+    via constants (MAGGY_TPU_RPC_RETRY_BASE / MAGGY_TPU_RPC_MAX_RETRIES)."""
+    base = constants.RPC_RETRY_BASE * (attempt + 1)
+    return base * (0.5 + random.random())
 
 
 # --------------------------------------------------------------------------- framing
@@ -279,13 +292,21 @@ class Server:
             writer.close()
             try:
                 await writer.wait_closed()
-            except Exception:
+            except Exception:  # noqa: BLE001 - peer already gone; close is best-effort
                 pass
 
     def _dispatch(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         if not secrets_mod.compare_digest(str(msg.get("secret", "")), self.secret):
             return {"type": "ERR", "error": "bad secret"}
         verb = msg.get("type", "")
+        ch = chaos_mod.get()
+        if ch is not None:
+            # chaos harness only: a matching rpc_stall rule delays this verb's
+            # reply — deliberately blocking the event loop, the way a wedged
+            # driver host stalls every connection at once
+            stall = ch.rpc_stall(verb)
+            if stall > 0:
+                time.sleep(stall)
         handler = self.callbacks.get(verb)
         if handler is None:
             return {"type": "ERR", "error": f"unknown verb {verb!r}"}
@@ -364,14 +385,14 @@ class Client:
 
     def _connect(self) -> socket.socket:
         last_err = None
-        for _ in range(constants.RPC_MAX_RETRIES):
+        for attempt in range(constants.RPC_MAX_RETRIES):
             try:
                 sock = socket.create_connection(self.server_addr, timeout=30)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return sock
             except OSError as e:
                 last_err = e
-                time.sleep(0.2)
+                time.sleep(_retry_delay(attempt))
         raise RpcError(f"Could not connect to driver at {self.server_addr}: {last_err}")
 
     def _request(self, msg: Dict[str, Any], heartbeat: bool = False) -> Dict[str, Any]:
@@ -402,7 +423,7 @@ class Client:
                 if tel is not None:
                     tel.rpc(verb, None, ok=False)
                 last_err = e
-                time.sleep(0.2 * (attempt + 1))
+                time.sleep(_retry_delay(attempt))
                 try:
                     if heartbeat:
                         self._hb_sock.close()
@@ -501,6 +522,9 @@ class Client:
         self._send_beat(reporter)  # final flush so no metrics/logs are lost
 
     def _send_beat(self, reporter) -> None:
+        ch = chaos_mod.get()
+        if ch is not None and ch.drop_heartbeat(self.partition_id):
+            return  # chaos: this worker goes silent for a beat
         trial_id, metric, step, logs = reporter.get_data()
         tel = self.telemetry
         beat = {

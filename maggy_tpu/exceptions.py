@@ -90,6 +90,14 @@ class RpcError(MaggyError):
     """Control-plane transport failure (connect/auth/framing)."""
 
 
+class WorkerLost(MaggyError):
+    """The worker hosting in-flight work died out from under it (preemption,
+    host loss, chaos kill). A TRANSIENT failure by definition: the runtime
+    requeues/restarts the interrupted work instead of failing the experiment
+    (resilience/policy.py classify_failure). Executors let this propagate —
+    it is a worker death, never a trial error."""
+
+
 class ReservationTimeoutError(MaggyError):
     """Not all executors registered within the reservation window
     (reference rpc.py:282-303 analogue)."""

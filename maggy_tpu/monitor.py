@@ -148,9 +148,26 @@ def _telemetry_lines(status: dict, width: int) -> list:
             parts.append(f"oom-pruned {g['tune.pruned_oom']:.0f}")
         if "tune.best_step_time" in g:
             parts.append(f"best {g['tune.best_step_time']:.1f}ms/step")
+        # resilience counters (maggy_tpu/resilience): what the runtime
+        # absorbed — requeued/exhausted trials, quarantines, worker deaths,
+        # elastic restarts, auto-resumes, preemption saves
+        c = snap.get("counters") or {}
+        res = {
+            k[len("resilience."):]: v
+            for k, v in c.items()
+            if k.startswith("resilience.")
+        }
+        if res:
+            parts.append(
+                "resilience "
+                + " ".join(f"{k}={v}" for k, v in sorted(res.items()))
+            )
+        if "checkpoint_fallback" in c:
+            parts.append(f"ckpt-fallback {c['checkpoint_fallback']}")
         if not parts:
             continue
-        lines.append(f"w{pid}: " + "  ".join(parts)[: width - 5])
+        tag = pid if pid == "driver" else f"w{pid}"
+        lines.append(f"{tag}: " + "  ".join(parts)[: width - 5])
     return lines
 
 
@@ -189,6 +206,21 @@ def render_status(status: dict, width: int = 78) -> str:
         seen = status.get("last_seen") or {}
         if seen:  # pod-mode HPO: remote trial workers' heartbeat ages
             lines.append(_heartbeat_line(seen))
+        # fault-recovery state: trials waiting out their retry backoff and
+        # workers sitting in quarantine (seconds until probation release)
+        requeued = status.get("trials_requeued")
+        quarantined = status.get("quarantined") or {}
+        if requeued or quarantined:
+            q = "  ".join(
+                f"w{pid}:{secs}s"
+                for pid, secs in sorted(quarantined.items(), key=_pid_key)
+            )
+            lines.append(
+                (
+                    f"resilience: requeued={requeued or 0}"
+                    + (f"  quarantined {q}" if q else "")
+                )[:width]
+            )
         lines.extend(_telemetry_lines(status, width))
         tail = status.get("controller_log") or []
         if tail:

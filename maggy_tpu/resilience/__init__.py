@@ -1,0 +1,48 @@
+"""Fault-tolerant experiment runtime.
+
+The reference maggy gets fault tolerance for free from Spark re-running
+executor tasks (spark_driver.py:136-145); our TPU-native runtime replaced
+Spark with its own RPC drivers, so recovery is a first-class runtime concern
+here instead. This package holds the policy and test substrate the three
+execution tiers thread through:
+
+* :mod:`maggy_tpu.resilience.policy` — transient-vs-deterministic failure
+  classification, :class:`RetryPolicy` (per-trial retry budget + exponential
+  backoff with deterministic jitter), and :class:`QuarantineTracker`
+  (a worker whose consecutive trials keep dying is taken out of scheduling
+  for a cooldown window).
+* :mod:`maggy_tpu.resilience.preemption` — SIGTERM/preemption hook installed
+  by ``Trainer.fit`` when it holds a checkpointer: one final synchronous save
+  before the process dies (preemptible TPU pods send SIGTERM ahead of
+  reclaim).
+* :mod:`maggy_tpu.resilience.chaos` — deterministic fault injector (kill
+  worker N at step K, drop heartbeats, stall an RPC reply, truncate a
+  checkpoint) on a config/env seam, so every recovery path is testable on
+  CPU without real preemptions.
+
+Consumers: ``core/driver/hpo.py`` (trial requeue + quarantine),
+``core/driver/distributed.py`` (bounded elastic restart),
+``train/trainer.py`` (``fit(resume="auto")`` + preemption save),
+``train/checkpoint.py`` (restore fallback), ``core/rpc.py`` (jittered
+reconnects, chaos seams). All recovery actions count ``resilience.*``
+telemetry so the monitor panel and exported traces show what the runtime
+absorbed.
+"""
+
+from __future__ import annotations
+
+from maggy_tpu.resilience.policy import (  # noqa: F401
+    DETERMINISTIC,
+    TRANSIENT,
+    QuarantineTracker,
+    RetryPolicy,
+    classify_failure,
+)
+
+__all__ = [
+    "TRANSIENT",
+    "DETERMINISTIC",
+    "classify_failure",
+    "RetryPolicy",
+    "QuarantineTracker",
+]

@@ -1,0 +1,183 @@
+"""Deterministic chaos harness: scripted faults on an env/config seam.
+
+Every recovery path in the runtime (trial requeue, worker quarantine,
+elastic restart, auto-resume, checkpoint fallback, heartbeat liveness) must
+be testable on a CPU dev box where nothing ever actually gets preempted.
+This module injects the failures deterministically: a :class:`Chaos` plan is
+a list of :class:`Fault` rules, each firing a bounded number of times when
+its match keys line up — same plan, same execution, same faults.
+
+Seams (all zero-cost when no plan is installed):
+
+* ``Trainer.fit`` calls :meth:`Chaos.kill` each step — a matching ``kill``
+  rule raises :class:`WorkerKilled` (a :class:`~maggy_tpu.exceptions.WorkerLost`),
+  which executors treat as worker death, not a trial error.
+* ``rpc.Client._send_beat`` consults ``hb_drop`` — the beat is silently
+  skipped, simulating a silent/preempted worker to the driver's liveness
+  sweep.
+* ``rpc.Server._dispatch`` consults ``rpc_stall`` — the matching verb's
+  reply is delayed by ``secs`` (this deliberately blocks the server loop;
+  chaos is a test harness, never production instrumentation).
+* :func:`truncate_checkpoint` corrupts a saved step in place so the
+  ``Checkpointer.restore`` fallback path can be exercised.
+
+Activation: install programmatically (``chaos.install(Chaos.parse(spec))``)
+or via ``MAGGY_TPU_CHAOS=<spec>`` in the environment — the env seam reaches
+subprocess workers the same way the telemetry flag does. Spec grammar::
+
+    MAGGY_TPU_CHAOS="kill:worker=1,step=3;hb_drop:worker=0,times=5;rpc_stall:verb=GET,secs=0.2"
+
+Rules are ``kind:key=value,...`` joined by ``;``. ``times`` bounds firings
+(default 1); omitted match keys match anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from maggy_tpu.exceptions import WorkerLost
+
+ENV_VAR = "MAGGY_TPU_CHAOS"
+
+
+class WorkerKilled(WorkerLost):
+    """Chaos-injected worker death (stands in for preemption/host loss)."""
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scripted fault: fire ``kind`` up to ``times`` times whenever every
+    entry of ``match`` equals the observed attribute (string-compared)."""
+
+    kind: str
+    match: Dict[str, str] = dataclasses.field(default_factory=dict)
+    times: int = 1
+    arg: float = 0.0  # rule payload (e.g. rpc_stall seconds)
+
+
+class Chaos:
+    """A deterministic fault plan; thread-safe, fires each rule exactly its
+    budgeted number of times."""
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = list(faults)
+        self._lock = threading.Lock()
+        self.fired: List[Tuple[str, Dict[str, Any]]] = []
+
+    @classmethod
+    def parse(cls, spec: str) -> "Chaos":
+        faults = []
+        for rule in spec.split(";"):
+            rule = rule.strip()
+            if not rule:
+                continue
+            kind, _, rest = rule.partition(":")
+            match: Dict[str, str] = {}
+            times, arg = 1, 0.0
+            for pair in filter(None, (p.strip() for p in rest.split(","))):
+                key, sep, value = pair.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"chaos rule {rule!r}: expected key=value, got {pair!r}"
+                    )
+                if key == "times":
+                    times = int(value)
+                elif key == "secs":
+                    arg = float(value)
+                else:
+                    match[key.strip()] = value.strip()
+            faults.append(Fault(kind.strip(), match, times=times, arg=arg))
+        return cls(faults)
+
+    def fire(self, kind: str, **attrs: Any) -> Optional[Fault]:
+        """Consume one firing of the first live rule of ``kind`` matching
+        ``attrs``; None when no rule applies."""
+        with self._lock:
+            for fault in self.faults:
+                if fault.kind != kind or fault.times <= 0:
+                    continue
+                if all(
+                    str(attrs.get(key)) == value
+                    for key, value in fault.match.items()
+                ):
+                    fault.times -= 1
+                    self.fired.append((kind, dict(attrs)))
+                    return fault
+        return None
+
+    # ------------------------------------------------------------- seam API
+
+    def kill(self, worker: Any, step: Optional[int] = None) -> None:
+        """Raise :class:`WorkerKilled` when a ``kill`` rule matches."""
+        if self.fire("kill", worker=worker, step=step) is not None:
+            raise WorkerKilled(
+                f"chaos: killed worker {worker}"
+                + (f" at step {step}" if step is not None else "")
+            )
+
+    def drop_heartbeat(self, worker: Any) -> bool:
+        """True when this worker's next heartbeat should be swallowed."""
+        return self.fire("hb_drop", worker=worker) is not None
+
+    def rpc_stall(self, verb: str) -> float:
+        """Seconds to stall the reply to ``verb`` (0.0 = no stall)."""
+        fault = self.fire("rpc_stall", verb=verb)
+        return fault.arg if fault is not None else 0.0
+
+
+def truncate_checkpoint(directory: str, step: Optional[int] = None) -> int:
+    """Corrupt a saved checkpoint step in place (default: the latest) by
+    truncating every payload file under it to half size — the on-disk shape
+    of a save interrupted mid-write. Returns the corrupted step."""
+    steps = sorted(int(name) for name in os.listdir(directory) if name.isdigit())
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {directory}")
+    step = int(step) if step is not None else steps[-1]
+    root = os.path.join(directory, str(step))
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(size // 2)
+    return step
+
+
+# ------------------------------------------------------------------ registry
+
+_lock = threading.Lock()
+_active: Optional[Chaos] = None
+_env_resolved = False
+
+
+def install(chaos: Optional[Chaos]) -> None:
+    """Install (or clear, with None) the process-wide fault plan."""
+    global _active, _env_resolved
+    with _lock:
+        _active = chaos
+        _env_resolved = True  # explicit install wins over the env seam
+
+
+def get() -> Optional[Chaos]:
+    """The active fault plan, lazily parsed from ``MAGGY_TPU_CHAOS`` once.
+    None (the overwhelmingly common case) costs one attribute read."""
+    global _active, _env_resolved
+    if _env_resolved:
+        return _active
+    with _lock:
+        if not _env_resolved:
+            spec = os.environ.get(ENV_VAR, "")
+            _active = Chaos.parse(spec) if spec else None
+            _env_resolved = True
+    return _active
+
+
+def reset() -> None:
+    """Clear the plan AND re-arm the env seam (test isolation)."""
+    global _active, _env_resolved
+    with _lock:
+        _active = None
+        _env_resolved = False

@@ -37,7 +37,7 @@ def _unregister() -> None:
     if writer is not None:
         try:
             writer.close()
-        except Exception:
+        except Exception:  # noqa: BLE001 - writer already broken; close is best-effort
             pass
     _local.logdir = None
     _local.writer = None
@@ -264,5 +264,5 @@ def scalar(tag: str, value: float, step: int) -> None:
     if writer:
         try:  # pragma: no cover - only with tensorboardX installed
             writer.add_scalar(tag, float(value), int(step))
-        except Exception:
+        except Exception:  # noqa: BLE001 - mirror is best-effort; json remains
             pass

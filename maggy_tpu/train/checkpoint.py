@@ -125,20 +125,51 @@ class Checkpointer:
         state built by ``Trainer.make_state``). Pass the live trainer's
         ``checkpoint_meta()`` as ``expect_meta`` to be warned when the
         checkpoint was written under a different sharding/microbatch/dtype
-        configuration."""
+        configuration.
+
+        Fallback (docs/resilience.md): when no explicit ``step`` was
+        requested and the latest retained step is unreadable/partial (a save
+        interrupted by the very crash being recovered from), older retained
+        steps are tried newest-first — each skip warns and counts a
+        ``checkpoint_fallback`` telemetry counter. An explicitly requested
+        step never falls back."""
         import orbax.checkpoint as ocp
 
         from maggy_tpu import telemetry
 
+        explicit = step is not None
         step = int(step) if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"No checkpoint found under {self.directory}")
-        if expect_meta is not None:
-            self._check_meta(step, expect_meta)
-        with telemetry.get().span("checkpoint_restore", step=step):
-            return self._manager.restore(
-                step, args=ocp.args.StandardRestore(state_template)
-            )
+        candidates = (
+            [step]
+            if explicit
+            else sorted((s for s in self.all_steps() if s <= step), reverse=True)
+        )
+        last_err: Optional[BaseException] = None
+        for i, s in enumerate(candidates):
+            if expect_meta is not None:
+                self._check_meta(s, expect_meta)
+            try:
+                with telemetry.get().span("checkpoint_restore", step=s):
+                    return self._manager.restore(
+                        s, args=ocp.args.StandardRestore(state_template)
+                    )
+            # broad: orbax surfaces corrupt/truncated checkpoints as many
+            # types (ValueError, json/msgpack decode errors, zarr/tensorstore
+            # failures) — anything but success means "this step is gone"
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                if explicit or i == len(candidates) - 1:
+                    raise
+                telemetry.get().count("checkpoint_fallback")
+                warnings.warn(
+                    f"checkpoint step {s} under {self.directory} is "
+                    f"unreadable ({type(e).__name__}: {e}); falling back to "
+                    f"the previous retained step {candidates[i + 1]}",
+                    stacklevel=2,
+                )
+        raise last_err  # unreachable; keeps the control flow explicit
 
     def restore_params(self, step: Optional[int] = None) -> Any:
         """Params-only restore for serving: pull just the ``params`` subtree

@@ -646,6 +646,7 @@ class Trainer:
         checkpoint_every: int = 0,
         profile_dir: Optional[str] = None,
         profile_steps: Tuple[int, int] = (3, 6),
+        resume: Optional[Any] = None,
     ) -> Tuple[TrainState, Dict[str, float]]:
         """Simple host-side loop: shard batch → step → optional reporter
         broadcast at step boundaries (where EarlyStopException can interrupt —
@@ -654,6 +655,18 @@ class Trainer:
         ``profile_dir`` captures a JAX/XLA profiler trace over
         ``profile_steps=(start, stop)`` (reference has no tracer, §5.1);
         ``checkpointer`` + ``checkpoint_every`` save the state periodically.
+
+        Resilience (docs/resilience.md): ``resume="auto"`` restores the
+        checkpointer's latest retained step over ``state`` (an explicit int
+        restores that step) and fast-forwards ``data_iter`` by the number of
+        steps already completed, so the loss trajectory continues exactly
+        where the interrupted run left off; ``num_steps`` stays the TOTAL
+        step budget for the run — only the remainder executes. With no
+        checkpoint on disk, ``resume="auto"`` is a fresh run. When a
+        checkpointer is present, fit also installs the SIGTERM/preemption
+        hook (:mod:`maggy_tpu.resilience.preemption`): on notice it performs
+        one final *synchronous* save at the current step and returns early
+        with ``metrics["preempted"] = 1.0``.
 
         Reported values are ``metric_sign * metrics[metric_key]``. Broadcast
         values MUST be the same quantity and orientation as the train_fn's
@@ -674,8 +687,43 @@ class Trainer:
         backpressure makes the mean converge to true step time).
         """
         from maggy_tpu import telemetry
+        from maggy_tpu.resilience import chaos as _chaos
+        from maggy_tpu.resilience import preemption as _preemption
 
         tel = telemetry.get()
+        resumed_from = None
+        skipped = 0
+        if resume is not None:
+            if checkpointer is None:
+                raise ValueError("fit(resume=...) requires a checkpointer")
+            target = (
+                checkpointer.latest_step() if resume == "auto" else int(resume)
+            )
+            if target is not None and target > int(state.step):
+                start = int(state.step)
+                state = checkpointer.restore(
+                    state,
+                    step=None if resume == "auto" else target,
+                    expect_meta=self.checkpoint_meta(),
+                )
+                resumed_from = int(state.step)
+                skipped = resumed_from - start
+                # fast-forward: the interrupted run consumed one batch per
+                # completed step — skip them so the data stream (and the loss
+                # trajectory) continues where it left off
+                for _ in range(skipped):
+                    next(data_iter)
+                tel.count("resilience.auto_resumes")
+                tel.gauge("resumed_step", resumed_from)
+        # num_steps is the TOTAL budget for this fit call; a resumed fit only
+        # executes the remainder
+        num_steps = max(0, num_steps - skipped)
+        # preemption notice -> one final synchronous save + early return;
+        # only armed when there is a checkpointer to save into
+        hook = _preemption.install() if checkpointer is not None else None
+        chaos = _chaos.get()
+        base_step = int(state.step) if chaos is not None else 0
+        preempted = False
         metrics = {}
         profiling = False
         prof_start = min(profile_steps[0], max(0, num_steps - 2))
@@ -685,6 +733,18 @@ class Trainer:
         step_ms_sum = 0.0
         try:
             for i in range(num_steps):
+                if chaos is not None:
+                    # deterministic fault injection (chaos harness): a
+                    # matching kill rule raises WorkerLost here
+                    chaos.kill(tel.worker, step=base_step + i)
+                if hook is not None and hook.requested():
+                    checkpointer.save(
+                        int(state.step), state, meta=self.checkpoint_meta()
+                    )
+                    checkpointer.wait()
+                    tel.count("resilience.preempt_saves")
+                    preempted = True
+                    break
                 if profile_dir is not None and not profiling and i == prof_start:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
@@ -727,6 +787,10 @@ class Trainer:
             if profiling:  # loop ended/raised while a trace was active
                 jax.profiler.stop_trace()
         out = {k: float(v) for k, v in metrics.items()}
+        if resumed_from is not None:
+            out["resumed_from"] = float(resumed_from)
+        if preempted:
+            out["preempted"] = 1.0
         # measured AFTER the float() conversions above — those force the
         # device->host sync that makes the wall time honest
         wall = time.perf_counter() - fit_t0

@@ -157,6 +157,21 @@ class Trial:
         with self._lock:
             self.status = Trial.ERROR
 
+    def reset_for_retry(self) -> None:
+        """Return a trial lost to a transient failure (worker death / RPC
+        loss) to PENDING for requeue: identity and params are kept, run
+        state — metrics, timing, assignment, early-stop flag — is cleared so
+        the retry reports a clean history. The retry counter lives in
+        ``info_dict['retries']`` and survives (the driver owns it)."""
+        with self._lock:
+            self.status = Trial.PENDING
+            self.assigned_to = None
+            self.start = None
+            self.duration = None
+            self.metric_history = []
+            self.step_history = []
+            self._early_stop = False
+
     # ------------------------------------------------------------------ metrics
 
     def append_metric(self, metric: float, step: Optional[int] = None) -> bool:

@@ -14,7 +14,7 @@ from maggy_tpu.core.driver.hpo import HyperparameterOptDriver
 from maggy_tpu.trial import Trial
 
 
-def make_driver(tmp_env, num_trials=4):
+def make_driver(tmp_env, num_trials=4, **kwargs):
     cfg = HyperparameterOptConfig(
         num_trials=num_trials,
         optimizer="randomsearch",
@@ -23,13 +23,15 @@ def make_driver(tmp_env, num_trials=4):
         es_policy="none",
         hb_interval=0.05,
         seed=0,
+        **kwargs,
     )
     return HyperparameterOptDriver(cfg, "app_fault", 1)
 
 
-def test_lost_trial_marked_error_and_rescheduled(tmp_env):
+def test_lost_trial_requeued_and_partition_rescheduled(tmp_env):
     """A worker re-registration (new attempt nonce) with an in-flight trial
-    must mark that trial ERROR and hand the partition a fresh one."""
+    must requeue that trial (transient loss, docs/resilience.md) and hand
+    the partition a fresh one meanwhile."""
     driver = make_driver(tmp_env)
     driver.server = driver._make_server()
     driver._register_msg_callbacks()
@@ -48,8 +50,36 @@ def test_lost_trial_marked_error_and_rescheduled(tmp_env):
     assert driver.server.reservations.register(0, {"attempt": "a2"})
     driver._digest_reg({"type": "REG", "partition_id": 0, "reregistered": True})
 
+    # the lost trial sits in the retry queue (NOT terminal ERROR) with its
+    # retry counter bumped...
+    queued = [t for _ready, t in driver._retry_queue]
+    assert [t.trial_id for t in queued] == [first]
+    assert queued[0].status == Trial.PENDING
+    assert queued[0].info_dict["retries"] == 1
+    assert not driver.final_store
+    # ...while the restarted partition immediately serves a different trial
+    second = driver.server.reservations.get_assignment(0)
+    assert second is not None and second != first
+
+
+def test_lost_trial_error_after_retry_budget(tmp_env):
+    """trial_retries=0 restores the terminal-ERROR behavior: the loss is
+    persisted and counted against the budget."""
+    driver = make_driver(tmp_env, trial_retries=0)
+    driver.server = driver._make_server()
+    driver._register_msg_callbacks()
+
+    driver.server.reservations.register(0, {"attempt": "a1"})
+    driver._digest_reg({"type": "REG", "partition_id": 0, "reregistered": False})
+    first = driver.server.reservations.get_assignment(0)
+    assert first is not None
+
+    assert driver.server.reservations.register(0, {"attempt": "a2"})
+    driver._digest_reg({"type": "REG", "partition_id": 0, "reregistered": True})
+
     lost = [t for t in driver.final_store if t.trial_id == first]
     assert len(lost) == 1 and lost[0].status == Trial.ERROR
+    assert not driver._retry_queue
     second = driver.server.reservations.get_assignment(0)
     assert second is not None and second != first
     # the lost trial persisted like any other

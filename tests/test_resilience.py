@@ -1,0 +1,422 @@
+"""Resilience subsystem: retry policy, quarantine, chaos injector,
+stranded-completion regression, RPC backoff jitter, monitor panel, and the
+exception-hygiene lint (docs/resilience.md)."""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from maggy_tpu import Searchspace
+from maggy_tpu.config import HyperparameterOptConfig
+from maggy_tpu.core import rpc
+from maggy_tpu.core.driver.hpo import HyperparameterOptDriver
+from maggy_tpu.exceptions import RpcError, WorkerLost
+from maggy_tpu.resilience import (
+    DETERMINISTIC,
+    TRANSIENT,
+    QuarantineTracker,
+    RetryPolicy,
+    classify_failure,
+)
+from maggy_tpu.resilience import chaos as chaos_mod
+from maggy_tpu.resilience import preemption
+from maggy_tpu.trial import Trial
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos_mod.reset()
+    yield
+    chaos_mod.reset()
+
+
+# ------------------------------------------------------------------- policy
+
+
+def test_classify_failure():
+    assert classify_failure(WorkerLost("preempted")) == TRANSIENT
+    assert classify_failure(chaos_mod.WorkerKilled("chaos")) == TRANSIENT
+    assert classify_failure(RpcError("conn reset")) == TRANSIENT
+    assert classify_failure(ConnectionResetError()) == TRANSIENT
+    assert classify_failure(TimeoutError()) == TRANSIENT
+    assert classify_failure(ValueError("bad hparam")) == DETERMINISTIC
+    assert classify_failure(RuntimeError("train_fn bug")) == DETERMINISTIC
+
+
+def test_retry_policy_backoff():
+    p = RetryPolicy(max_retries=3, backoff_base=0.5, backoff_factor=2.0,
+                    backoff_cap=4.0, jitter=0.25, seed=7)
+    delays = [p.delay(a) for a in range(6)]
+    # deterministic: same policy, same attempt -> same delay
+    assert delays == [p.delay(a) for a in range(6)]
+    # exponential growth within jitter bounds, capped
+    for a, d in enumerate(delays):
+        base = min(0.5 * 2.0**a, 4.0)
+        assert base * 0.75 <= d <= base
+    assert delays[5] <= 4.0
+    # different seeds de-synchronize
+    assert RetryPolicy(seed=1).delay(0) != RetryPolicy(seed=2).delay(0)
+
+
+def test_retry_policy_env_override(monkeypatch):
+    cfg = HyperparameterOptConfig(
+        num_trials=1, optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0, 1])),
+        trial_retries=5, retry_backoff=2.0,
+    )
+    assert RetryPolicy.from_config(cfg).max_retries == 5
+    assert RetryPolicy.from_config(cfg).backoff_base == 2.0
+    monkeypatch.setenv("MAGGY_TPU_TRIAL_RETRIES", "1")
+    monkeypatch.setenv("MAGGY_TPU_RETRY_BACKOFF", "0.1")
+    assert RetryPolicy.from_config(cfg).max_retries == 1
+    assert RetryPolicy.from_config(cfg).backoff_base == 0.1
+
+
+def test_quarantine_tracker():
+    q = QuarantineTracker(threshold=3, cooldown=100.0)
+    t0 = 1000.0
+    assert not q.record_failure(1, now=t0)
+    assert not q.record_failure(1, now=t0)
+    # a success resets the streak
+    q.record_success(1)
+    assert not q.record_failure(1, now=t0)
+    assert not q.record_failure(1, now=t0)
+    assert q.record_failure(1, now=t0)  # third consecutive -> quarantined
+    assert q.is_quarantined(1, now=t0 + 50)
+    assert q.quarantined(now=t0 + 50) == [1]
+    # other workers unaffected
+    assert not q.is_quarantined(2, now=t0 + 50)
+    # cooldown elapses -> released on probation...
+    assert not q.is_quarantined(1, now=t0 + 101)
+    # ...where a single further death re-quarantines immediately
+    assert q.record_failure(1, now=t0 + 102)
+    assert q.is_quarantined(1, now=t0 + 103)
+
+
+# -------------------------------------------------------------------- chaos
+
+
+def test_chaos_parse_and_fire_deterministic():
+    ch = chaos_mod.Chaos.parse(
+        "kill:worker=1,step=3;hb_drop:worker=0,times=2;rpc_stall:verb=GET,secs=0.25"
+    )
+    # no match: wrong worker / wrong step
+    ch.kill(worker=0, step=3)
+    ch.kill(worker=1, step=2)
+    with pytest.raises(chaos_mod.WorkerKilled):
+        ch.kill(worker=1, step=3)
+    # times=1 consumed: the same point never fires twice (resume safety)
+    ch.kill(worker=1, step=3)
+
+    assert ch.drop_heartbeat(0)
+    assert ch.drop_heartbeat(0)
+    assert not ch.drop_heartbeat(0)  # budget of 2 spent
+    assert not ch.drop_heartbeat(1)  # other workers unaffected
+
+    assert ch.rpc_stall("GET") == 0.25
+    assert ch.rpc_stall("GET") == 0.0
+    assert ch.rpc_stall("FINAL") == 0.0
+    assert ("kill", {"worker": 1, "step": 3}) in ch.fired
+
+
+def test_chaos_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        chaos_mod.Chaos.parse("kill:worker")
+
+
+def test_chaos_env_seam(monkeypatch):
+    chaos_mod.reset()
+    monkeypatch.setenv(chaos_mod.ENV_VAR, "kill:worker=9")
+    ch = chaos_mod.get()
+    assert ch is not None
+    with pytest.raises(chaos_mod.WorkerKilled):
+        ch.kill(worker=9)
+    # explicit install wins over env; reset re-arms the env seam
+    chaos_mod.install(None)
+    assert chaos_mod.get() is None
+    chaos_mod.reset()
+    monkeypatch.delenv(chaos_mod.ENV_VAR)
+    assert chaos_mod.get() is None
+
+
+def test_chaos_rpc_stall_through_server():
+    """The server-side stall seam delays the matching verb's reply."""
+    chaos_mod.install(chaos_mod.Chaos.parse("rpc_stall:verb=QUERY,secs=0.3"))
+    server = rpc.Server(1)
+    server.register_callback("QUERY", lambda m: {"type": "QUERY", "ready": True})
+    server.start()
+    try:
+        client = rpc.Client((server.host, server.port), 0, server.secret)
+        t0 = time.perf_counter()
+        assert client._request({"type": "QUERY"})["ready"]
+        stalled = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        assert client._request({"type": "QUERY"})["ready"]
+        clean = time.perf_counter() - t0
+        client.stop()
+        assert stalled >= 0.3
+        assert clean < 0.3
+    finally:
+        server.stop()
+
+
+def test_chaos_drops_heartbeats():
+    """A matching hb_drop rule swallows beats client-side: the driver sees
+    silence, exactly like a preempted worker."""
+    from maggy_tpu.reporter import Reporter
+
+    chaos_mod.install(chaos_mod.Chaos.parse("hb_drop:worker=3,times=100"))
+    beats = []
+    server = rpc.Server(1)
+    server.register_callback(
+        "METRIC", lambda m: beats.append(m["partition_id"]) or {"type": "OK"}
+    )
+    server.start()
+    try:
+        reporter = Reporter(log_file=os.devnull, partition_id=3)
+        client = rpc.Client((server.host, server.port), 3, server.secret,
+                            hb_interval=0.02)
+        client.start_heartbeat(reporter)
+        time.sleep(0.2)
+        client.stop()
+        reporter.close()
+        assert beats == []  # every beat swallowed
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------- driver-level scheduling
+
+
+def make_driver(tmp_env, num_trials=4, **kwargs):
+    cfg = HyperparameterOptConfig(
+        num_trials=num_trials,
+        optimizer="randomsearch",
+        searchspace=Searchspace(x=("DOUBLE", [0.0, 1.0])),
+        num_executors=2,
+        es_policy="none",
+        hb_interval=0.05,
+        seed=0,
+        **kwargs,
+    )
+    return HyperparameterOptDriver(cfg, "app_resil", 1)
+
+
+def _register_and_assign(driver, pid, attempt="a1"):
+    driver.server.reservations.register(pid, {"attempt": attempt})
+    driver._digest_reg({"type": "REG", "partition_id": pid, "reregistered": False})
+    return driver.server.reservations.get_assignment(pid)
+
+
+def test_requeued_trial_outranks_fresh_suggestions(tmp_env):
+    """With zero backoff the lost trial goes straight back to the next free
+    worker — retried, not re-suggested."""
+    driver = make_driver(tmp_env, retry_backoff=0.0)
+    driver.server = driver._make_server()
+    driver._register_msg_callbacks()
+
+    first = _register_and_assign(driver, 0)
+    assert first is not None
+    # restart: the re-REG frees the trial, and the immediate _try_assign
+    # hands the SAME trial back (backoff 0, retries remain)
+    driver.server.reservations.register(0, {"attempt": "a2"})
+    driver._digest_reg({"type": "REG", "partition_id": 0, "reregistered": True})
+    assert driver.server.reservations.get_assignment(0) == first
+    assert driver.trial_store[first].info_dict["retries"] == 1
+
+
+def test_worker_quarantined_after_consecutive_losses(tmp_env):
+    """Three consecutive lost trials quarantine the worker out of
+    _try_assign; a healthy worker keeps serving."""
+    driver = make_driver(
+        tmp_env, num_trials=8, trial_retries=8, retry_backoff=0.0,
+        quarantine_after=3, quarantine_cooldown=60.0,
+    )
+    driver.server = driver._make_server()
+    driver._register_msg_callbacks()
+
+    assert _register_and_assign(driver, 0) is not None
+    for n in range(2, 5):  # three worker restarts with in-flight trials
+        driver.server.reservations.register(0, {"attempt": f"a{n}"})
+        driver._digest_reg({"type": "REG", "partition_id": 0, "reregistered": True})
+    assert driver.quarantine.is_quarantined(0)
+    # the quarantined worker gets nothing
+    assert driver.server.reservations.get_assignment(0) is None
+    driver._try_assign(0)
+    assert driver.server.reservations.get_assignment(0) is None
+    # a different worker still serves (and picks up the requeued trial)
+    assert _register_and_assign(driver, 1, attempt="b1") is not None
+    assert driver.telemetry.snapshot()["counters"]["resilience.workers_quarantined"] == 1
+
+
+def test_stranded_completion_regression(tmp_env):
+    """ISSUE 4 satellite: the final worker dying *before* budget exhaustion
+    with an empty queue used to hang _await_completion (the old sweep only
+    finished when _optimizer_exhausted). _maybe_finish now probes the
+    controller directly and completes the experiment."""
+    driver = make_driver(tmp_env, num_trials=2, trial_retries=0)
+    driver.server = driver._make_server()
+    driver._register_msg_callbacks()
+
+    first = _register_and_assign(driver, 0)
+    # trial 1 finishes cleanly; _digest_final assigns trial 2
+    driver.server.reservations.assign_trial(0, None)
+    driver._digest_final(
+        {"type": "FINAL", "partition_id": 0, "trial_id": first, "metric": 1.0,
+         "outputs": {}}
+    )
+    second = driver.server.reservations.get_assignment(0)
+    assert second is not None and second != first
+    assert not driver._optimizer_exhausted  # budget not yet exhausted
+
+    # the ONLY worker dies with trial 2 in flight (retry budget 0): nobody is
+    # left to poll the controller — the driver must still complete
+    driver._digest_worker_lost(
+        {"type": "_WORKER_LOST", "partition_id": 0, "error": "RpcError: gone"}
+    )
+    assert driver.experiment_done.is_set()
+    assert len(driver.final_store) == 2
+    statuses = sorted(t.status for t in driver.final_store)
+    assert statuses == [Trial.ERROR, Trial.FINALIZED]
+
+
+def test_retry_waits_out_backoff(tmp_env):
+    """A requeued trial is not schedulable before its backoff elapses."""
+    driver = make_driver(tmp_env, retry_backoff=30.0)
+    driver.server = driver._make_server()
+    driver._register_msg_callbacks()
+
+    first = _register_and_assign(driver, 0)
+    driver.server.reservations.register(0, {"attempt": "a2"})
+    driver._digest_reg({"type": "REG", "partition_id": 0, "reregistered": True})
+    # the retry sits in the queue (backoff ~30s); the worker got a FRESH trial
+    assert [t.trial_id for _r, t in driver._retry_queue] == [first]
+    assert driver.server.reservations.get_assignment(0) != first
+
+
+# ------------------------------------------------------------ rpc satellites
+
+
+def test_rpc_retry_delay_jitter():
+    delays = [rpc._retry_delay(0) for _ in range(50)]
+    from maggy_tpu import constants
+
+    base = constants.RPC_RETRY_BASE
+    assert all(base * 0.5 <= d <= base * 1.5 for d in delays)
+    assert len(set(delays)) > 1  # actually jittered
+    # linear growth of the base
+    assert min(rpc._retry_delay(4) for _ in range(50)) > max(delays) / 3
+
+
+def test_rpc_constants_env_overrides(monkeypatch):
+    import importlib
+
+    from maggy_tpu import constants
+
+    monkeypatch.setenv("MAGGY_TPU_RPC_MAX_RETRIES", "7")
+    monkeypatch.setenv("MAGGY_TPU_RPC_RETRY_BASE", "0.05")
+    importlib.reload(constants)
+    try:
+        assert constants.RPC_MAX_RETRIES == 7
+        assert constants.RPC_RETRY_BASE == 0.05
+        monkeypatch.setenv("MAGGY_TPU_RPC_MAX_RETRIES", "garbage")
+        importlib.reload(constants)
+        assert constants.RPC_MAX_RETRIES == 3  # bad value -> default
+    finally:
+        monkeypatch.delenv("MAGGY_TPU_RPC_MAX_RETRIES")
+        monkeypatch.delenv("MAGGY_TPU_RPC_RETRY_BASE")
+        importlib.reload(constants)
+
+
+# ------------------------------------------------------------------ preempt
+
+
+def test_preemption_hook_sigterm():
+    hook = preemption.install()  # pytest runs tests on the main thread
+    try:
+        assert signal.getsignal(signal.SIGTERM) == hook._handler
+        assert not hook.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert hook.wait(timeout=5)
+        assert hook.requested()
+    finally:
+        hook.clear()
+
+
+def test_preemption_request_from_any_thread():
+    preemption.clear()
+    t = threading.Thread(target=preemption.request)
+    t.start()
+    t.join()
+    assert preemption.requested()
+    preemption.clear()
+
+
+# ------------------------------------------------------------------ monitor
+
+
+def test_monitor_renders_resilience_panel():
+    from maggy_tpu.monitor import render_status
+
+    status = {
+        "name": "exp", "kind": "HyperparameterOptDriver", "state": "RUNNING",
+        "app_id": "a", "run_id": 1, "num_executors": 2, "elapsed_s": 5.0,
+        "trials_total": 8, "trials_done": 3, "trials_running": 1,
+        "trials_requeued": 2, "quarantined": {"1": 42.0},
+        "direction": "max", "controller": "RandomSearch",
+        "telemetry": {
+            "driver": {
+                "counters": {
+                    "resilience.trials_requeued": 3,
+                    "resilience.workers_quarantined": 1,
+                    "checkpoint_fallback": 1,
+                }
+            }
+        },
+    }
+    panel = render_status(status)
+    assert "requeued=2" in panel
+    assert "quarantined w1:42.0s" in panel
+    assert "trials_requeued=3" in panel
+    assert "workers_quarantined=1" in panel
+    assert "ckpt-fallback 1" in panel
+    assert "driver:" in panel
+
+
+# ----------------------------------------------------------------- CI lint
+
+
+def test_exception_hygiene_lint():
+    """tools/check_exception_hygiene.py runs clean over maggy_tpu/ (wired
+    into tier-1 here, beside the bare-print and docs-nav lints)."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_exception_hygiene",
+        os.path.join(repo, "tools", "check_exception_hygiene.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0
+
+    # the detector itself
+    bare = "try:\n    x()\nexcept:\n    pass\n"
+    assert mod.find_violations(bare, "<s>")
+    swallow = "try:\n    x()\nexcept Exception:\n    pass\n"
+    assert mod.find_violations(swallow, "<s>")
+    justified = "try:\n    x()\nexcept Exception:  # best-effort cleanup\n    pass\n"
+    assert mod.find_violations(justified, "<s>") == []
+    body_comment = (
+        "try:\n    x()\nexcept Exception:\n    # optional backend missing\n    pass\n"
+    )
+    assert mod.find_violations(body_comment, "<s>") == []
+    handled = "try:\n    x()\nexcept Exception as e:\n    log(e)\n"
+    assert mod.find_violations(handled, "<s>") == []
+    narrow = "try:\n    x()\nexcept OSError:\n    pass\n"
+    assert mod.find_violations(narrow, "<s>") == []
+    broad_tuple = "try:\n    x()\nexcept (ValueError, Exception):\n    pass\n"
+    assert mod.find_violations(broad_tuple, "<s>")

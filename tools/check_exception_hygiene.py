@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Lint: ban silent exception swallows inside ``maggy_tpu/``.
+
+A fault-tolerant runtime lives or dies by what it does with exceptions: the
+resilience machinery (docs/resilience.md) classifies failures to decide
+between retry and fail-fast, and a handler that silently eats an error
+upstream starves that classification. Two patterns are flagged:
+
+* **bare except** — ``except:`` catches ``SystemExit``/``KeyboardInterrupt``
+  too and is never acceptable; name a type (``BaseException`` if you truly
+  mean everything, with a comment saying why).
+* **broad swallow** — ``except Exception:`` / ``except BaseException:``
+  whose body is only ``pass``, with no justification. A deliberate swallow
+  is fine — best-effort logging, optional backends — but it must say so: a
+  trailing comment on the ``except`` line (or a comment line as the first
+  thing in the handler body) acts as the per-site allowlist entry.
+
+``ALLOWLIST`` below escapes whole files that legitimately cannot carry
+markers (none today; add sparingly with a reason).
+
+Usage: ``python tools/check_exception_hygiene.py [root]`` — exits nonzero
+listing violations. Wired into the tier-1 run via ``tests/test_resilience.py``,
+beside ``check_no_bare_print.py`` and ``check_docs_nav.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+import tokenize
+from typing import List, Set, Tuple
+
+# file basenames exempt from the whole check, with a reason each
+ALLOWLIST: Set[str] = set()
+
+BROAD_NAMES = ("Exception", "BaseException")
+
+
+def _is_broad(type_node) -> bool:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in BROAD_NAMES
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(el) for el in type_node.elts)
+    return False
+
+
+def _comment_lines(source: str) -> Set[int]:
+    """Line numbers carrying a comment (the justification-marker seam)."""
+    out: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(iter(source.splitlines(True)).__next__):
+            if tok.type == tokenize.COMMENT:
+                out.add(tok.start[0])
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def find_violations(source: str, path: str) -> List[Tuple[int, str]]:
+    """(line, description) for every unhygienic handler in ``source``."""
+    tree = ast.parse(source, filename=path)
+    comments = _comment_lines(source)
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append((node.lineno, "bare 'except:' — name an exception type"))
+            continue
+        only_pass = all(isinstance(stmt, ast.Pass) for stmt in node.body)
+        if not (_is_broad(node.type) and only_pass):
+            continue
+        # justification: a comment on the except line itself, or any comment
+        # line between it and the first body statement (inclusive)
+        first_body = node.body[0].lineno
+        if any(ln in comments for ln in range(node.lineno, first_body + 1)):
+            continue
+        out.append(
+            (
+                node.lineno,
+                "broad silent swallow (except Exception: pass) without a "
+                "justifying comment",
+            )
+        )
+    return out
+
+
+def check_tree(root: str) -> List[Tuple[str, int, str]]:
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))]
+        for name in sorted(filenames):
+            if not name.endswith(".py") or name in ALLOWLIST:
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            try:
+                hits = find_violations(source, path)
+            except SyntaxError as e:
+                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
+                continue
+            violations.extend((path, line, what) for line, what in hits)
+    return violations
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = args[0] if args else os.path.join(repo, "maggy_tpu")
+    violations = check_tree(root)
+    for path, line, what in violations:
+        print(f"{path}:{line}: {what}", file=sys.stderr)
+    if violations:
+        print(f"{len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
