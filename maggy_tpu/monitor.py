@@ -124,6 +124,15 @@ def _telemetry_lines(status: dict, width: int) -> list:
             parts.append(f"{g['steps_per_sec']:.2f}st/s")
         if "tokens_per_sec" in g:
             parts.append(f"{g['tokens_per_sec']:,.0f}tok/s")
+        # host-overlap health (docs/performance.md): time the step loop sat
+        # waiting on the input pipeline, prefetch queue occupancy, and how
+        # many steps behind the lagged metrics drain is running
+        if "input_wait_ms" in g:
+            parts.append(f"in-wait {g['input_wait_ms']:.1f}ms")
+        if "prefetch_depth" in g:
+            parts.append(f"prefetch {g['prefetch_depth']:.0f}")
+        if "metrics_lag" in g:
+            parts.append(f"lag {g['metrics_lag']:.0f}")
         if "mfu_est" in g:
             parts.append(f"mfu {100 * g['mfu_est']:.1f}%")
         if "compile_time_ms" in g:
@@ -138,6 +147,8 @@ def _telemetry_lines(status: dict, width: int) -> list:
             parts.append(f"queue {g['serve.queue_depth']:.0f}")
         if "serve.active_slots" in g:
             parts.append(f"slots {g['serve.active_slots']:.0f}")
+        if "serve.drain_ms" in g:
+            parts.append(f"drain {g['serve.drain_ms']:.1f}ms")
         if "serve.decode_retraces" in g:
             parts.append(f"compiles {g['serve.decode_retraces']:.0f}")
         # autotuner progress (maggy_tpu/tune): candidate grid, AOT prunes,

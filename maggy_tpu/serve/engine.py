@@ -26,13 +26,28 @@ slot it landed in or what else shared the batch.
 The per-row cache index (models/transformer.py ``_cached_attention``) is
 what makes this work: slots sit at different sequence positions inside one
 compiled program.
+
+**Async decode (default; docs/performance.md):** ``step()`` dispatches
+decode step ``i+1`` BEFORE host-reading step ``i``'s sampled tokens.
+Continuing slots take their input token straight from the in-flight device
+output (``jnp.where(use_prev, prev_sampled, host_tokens)`` inside the jit),
+so the device→host→device round-trip per token disappears; the host drains
+step ``i`` (``serve.drain_ms`` gauge) while step ``i+1`` computes. Token
+streams are byte-identical to the synchronous path — the tokens fed forward
+are the same sampled values, positions/keys advance identically, and the
+one extra post-finish step a slot decodes before the host learns it
+finished is discarded at drain (slot/request identity is checked). Pass
+``async_decode=False`` (or ``MAGGY_TPU_SERVE_ASYNC=0``) for the strict
+synchronous path.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
-from typing import Any, Dict, Tuple
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -96,6 +111,7 @@ class Engine:
         num_slots: int = 4,
         mesh=None,
         telemetry_recorder=None,
+        async_decode: Optional[bool] = None,
     ):
         from maggy_tpu.models import Decoder
 
@@ -112,6 +128,12 @@ class Engine:
         self.max_seq_len = int(cfg.max_seq_len)
         self.telemetry = telemetry_recorder or telemetry.get()
 
+        if async_decode is None:
+            async_decode = os.environ.get(
+                "MAGGY_TPU_SERVE_ASYNC", "1"
+            ).lower() not in ("0", "false", "off")
+        self.async_decode = async_decode
+
         B = num_slots
         dummy = jnp.zeros((B, 1), jnp.int32)
         self.cache = init_cache(self.decode_model, dummy, mesh=mesh)
@@ -119,6 +141,11 @@ class Engine:
         # sharded cache resolve; mesh-free (single chip / CPU) costs nothing
         self._ctx = (lambda: mesh) if mesh is not None else contextlib.nullcontext
         self.key_data = jnp.zeros((B, 2), jnp.uint32)
+        # async double buffer: the dispatched-but-undrained decode step —
+        # its device token refs plus (slot -> request id) at dispatch time,
+        # so a drain can discard rows whose slot churned in the meantime
+        self._pending: Optional[Dict[str, Any]] = None
+        self._zero_tokens = jnp.zeros((B,), jnp.int32)
 
         # trace-time side effects: these counters tick ONLY when jax retraces
         # the function, so they count compiles, not calls — the acceptance
@@ -183,12 +210,30 @@ class Engine:
         return cache, key_data
 
     def _decode_impl(
-        self, params, cache, key_data, tokens, pos, active, temp, top_k, gen_idx
+        self,
+        params,
+        cache,
+        key_data,
+        prev_tokens,
+        host_tokens,
+        use_prev,
+        pos,
+        active,
+        temp,
+        top_k,
+        gen_idx,
     ):
         """One token for every slot; inactive rows run masked (their cache
         index is reset to 0 afterwards so they never inflate the chunked
-        cache-read bound or run past max_seq_len)."""
+        cache-read bound or run past max_seq_len).
+
+        ``prev_tokens`` is the previous dispatch's on-device sampled output;
+        rows with ``use_prev`` feed it forward directly (async double
+        buffer — the value never visits the host), the rest (fresh
+        admissions, and every row on the synchronous path) take
+        ``host_tokens``."""
         self._decode_traces += 1
+        tokens = jnp.where(use_prev, prev_tokens, host_tokens)
         logits, mutated = self.decode_model.apply(
             {"params": params, "cache": cache},
             tokens[:, None],
@@ -209,7 +254,12 @@ class Engine:
             return leaf
 
         cache = jax.tree_util.tree_map_with_path(clamp_index, cache)
-        return cache, sampled
+        # advanced coordinates for the steady-state async fast path: while
+        # the slot set is unchanged, the next dispatch reuses these device
+        # refs verbatim — zero host arrays built or transferred per token
+        next_pos = jnp.where(active, pos + 1, pos)
+        next_gen = jnp.where(active, gen_idx + 1, gen_idx)
+        return cache, sampled, next_pos, next_gen
 
     # -------------------------------------------------------------- admission
 
@@ -277,47 +327,121 @@ class Engine:
 
     # ----------------------------------------------------------------- decode
 
-    def step(self) -> StepOutput:
-        """Decode one token for every active slot (no-op when all are free)."""
+    def step(self) -> StepOutput:  # hot-loop (tools/check_host_sync.py)
+        """Decode one token for every active slot.
+
+        Synchronous mode returns THIS dispatch's tokens. Async mode (the
+        default) returns the PREVIOUS dispatch's tokens — the new dispatch is
+        issued first (its inputs chain from the in-flight device output), so
+        the host-side drain/bookkeeping below overlaps device compute. With
+        all slots free this degenerates to :meth:`flush`.
+        """
         active_ids = self.slots.active_slots()
         if not active_ids:
-            return StepOutput(tokens={})
-        B = self.slots.num_slots
-        tokens = np.zeros((B,), np.int32)
-        pos = np.zeros((B,), np.int32)
-        active = np.zeros((B,), bool)
-        temp = np.zeros((B,), np.float32)
-        top_k = np.zeros((B,), np.int32)
-        gen_idx = np.zeros((B,), np.int32)
-        for s in active_ids:
-            st = self.slots.get(s)
-            tokens[s] = st.last_token
-            pos[s] = st.next_pos
-            active[s] = True
-            temp[s] = st.request.params.temperature
-            top_k[s] = st.request.params.top_k
-            gen_idx[s] = st.generated
-        with self.telemetry.span("serve.decode_step", active=len(active_ids)), self._ctx():
-            self.cache, sampled = self._decode_jit(
-                self.params,
-                self.cache,
-                self.key_data,
-                jnp.asarray(tokens),
-                jnp.asarray(pos),
-                jnp.asarray(active),
-                jnp.asarray(temp),
-                jnp.asarray(top_k),
+            return self.flush()
+        prev = self._pending
+        entries = {s: self.slots.get(s).request.id for s in active_ids}
+        if (
+            self.async_decode
+            and prev is not None
+            and prev["slots"] == entries
+        ):
+            # steady state (no churn since the last dispatch): every input
+            # is a carried device ref — the previous step's own outputs.
+            # use_prev == active (every live row continues its stream), so
+            # no host array is built or transferred for this token at all.
+            c = prev["carry"]
+            inputs = (
+                prev["sampled"], self._zero_tokens, c["active"], c["pos"],
+                c["active"], c["temp"], c["top_k"], c["gen"],
+            )
+            carry_static = c
+        else:
+            B = self.slots.num_slots
+            host_tokens = np.zeros((B,), np.int32)
+            use_prev = np.zeros((B,), bool)
+            pos = np.zeros((B,), np.int32)
+            active = np.zeros((B,), bool)
+            temp = np.zeros((B,), np.float32)
+            top_k = np.zeros((B,), np.int32)
+            gen_idx = np.zeros((B,), np.int32)
+            for s in active_ids:
+                st = self.slots.get(s)
+                # a slot still holding the request it held at the previous
+                # dispatch has exactly ONE undrained token in flight: feed
+                # it forward on-device and advance pos/gen_idx past it
+                lag = 1 if (
+                    self.async_decode
+                    and prev is not None
+                    and prev["slots"].get(s) == st.request.id
+                ) else 0
+                if lag:
+                    use_prev[s] = True
+                else:
+                    host_tokens[s] = st.last_token
+                pos[s] = st.next_pos + lag
+                gen_idx[s] = st.generated + lag
+                active[s] = True
+                temp[s] = st.request.params.temperature
+                top_k[s] = st.request.params.top_k
+            prev_tokens = (
+                prev["sampled"] if prev is not None else self._zero_tokens
+            )
+            active_dev = jnp.asarray(active)
+            temp_dev = jnp.asarray(temp)
+            top_k_dev = jnp.asarray(top_k)
+            inputs = (
+                prev_tokens, jnp.asarray(host_tokens), jnp.asarray(use_prev),
+                jnp.asarray(pos), active_dev, temp_dev, top_k_dev,
                 jnp.asarray(gen_idx),
             )
-            sampled = np.asarray(sampled)
+            carry_static = {
+                "active": active_dev, "temp": temp_dev, "top_k": top_k_dev,
+            }
+        with self.telemetry.span("serve.decode_step", active=len(active_ids)), self._ctx():
+            self.cache, sampled, next_pos, next_gen = self._decode_jit(
+                self.params, self.cache, self.key_data, *inputs
+            )
+        self.steps += 1
+        self._record_compile_gauges()
+        dispatched = {
+            "sampled": sampled,
+            "slots": entries,
+            "carry": {**carry_static, "pos": next_pos, "gen": next_gen},
+        }
+        if not self.async_decode:
+            return self._drain(dispatched)
+        self._pending = dispatched
+        # drain the PREVIOUS step while this one crunches on the device
+        return self._drain(prev)
+
+    def flush(self) -> StepOutput:
+        """Drain the in-flight async dispatch, if any. The scheduler calls
+        this when the active set empties (and may call it before
+        cancellation/deadline decisions that need host-current state); the
+        synchronous path has nothing pending and returns an empty output."""
+        prev, self._pending = self._pending, None
+        return self._drain(prev)
+
+    def _drain(self, pending: Optional[Dict[str, Any]]) -> StepOutput:
+        """Host-read one dispatched step's tokens and advance the slot
+        mirror. Rows whose slot was released or re-admitted since dispatch
+        (the post-finish garbage step async mode inevitably runs) are
+        discarded — slot/request identity gates every emit."""
+        if pending is None:
+            return StepOutput(tokens={})
+        t0 = time.perf_counter()
+        sampled = np.asarray(pending["sampled"])  # sync: ok — lagged double-buffer drain
+        self.telemetry.gauge("serve.drain_ms", (time.perf_counter() - t0) * 1e3)
         out: Dict[int, int] = {}
-        for s in active_ids:
+        for s, rid in pending["slots"].items():
+            st = self.slots.get(s)
+            if st is None or st.request.id != rid:
+                continue  # slot churned since dispatch; token belongs to no one
             tok = int(sampled[s])
             self.slots.advance(s, tok)
             out[s] = tok
-        self.steps += 1
-        self.tokens_out += len(active_ids)
-        self._record_compile_gauges()
+        self.tokens_out += len(out)
         return StepOutput(tokens=out)
 
     # -------------------------------------------------------------- telemetry

@@ -9,9 +9,11 @@ socket loop responsive while XLA crunches.
 
 Telemetry (continuously, into the ambient or provided recorder):
 ``serve.queue_depth``, ``serve.active_slots``, ``serve.tokens_per_sec``
-(EMA over loop iterations), ``serve.ttft_ms`` per admission, and the
-engine's retrace gauges. Counters: ``serve.requests_{submitted,done,
-cancelled,expired,failed,rejected}`` and ``serve.tokens_out``.
+(EMA over loop iterations), ``serve.ttft_ms`` per admission,
+``serve.drain_ms`` (host-blocked time per async token drain —
+docs/performance.md), and the engine's retrace gauges. Counters:
+``serve.requests_{submitted,done,cancelled,expired,failed,rejected}`` and
+``serve.tokens_out``.
 """
 
 from __future__ import annotations
@@ -267,6 +269,10 @@ class Scheduler:
                 )
                 tel.gauge("serve.tokens_per_sec", self._tok_rate_ema)
             else:
+                # async decode leaves the last dispatch in flight when the
+                # active set empties (its rows all belong to finished
+                # requests); retire it so no device refs linger across idle
+                self.engine.flush()
                 with self._wake:
                     if not self._queue and not self._stop.is_set():
                         self._wake.wait(timeout=IDLE_WAIT_S)

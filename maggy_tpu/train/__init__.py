@@ -1,4 +1,5 @@
 from maggy_tpu.train.trainer import Trainer, TrainContext, lm_loss_fn, classification_loss_fn
+from maggy_tpu.train.prefetch import DevicePrefetcher, skip_batches
 from maggy_tpu.train.sharded_dataset import (
     ParquetShardedDataset,
     ShardedDataset,
@@ -11,6 +12,8 @@ __all__ = [
     "TrainContext",
     "lm_loss_fn",
     "classification_loss_fn",
+    "DevicePrefetcher",
+    "skip_batches",
     "ParquetShardedDataset",
     "ShardedDataset",
     "write_parquet",

@@ -26,6 +26,91 @@ def host_shard(arrays: Dict[str, np.ndarray], process_index: int, num_processes:
     return out
 
 
+class BatchIterator:
+    """Infinite (or one-epoch) minibatch iterator over array dicts, with an
+    index-only ``skip(n)`` fast path.
+
+    Batch-for-batch identical to the generator it replaced: one permutation
+    is drawn per epoch from a single seeded RNG stream, so ``skip`` (which
+    advances epoch/offset counters and draws the skipped epochs'
+    permutations WITHOUT gathering any rows) lands on exactly the batch a
+    ``next()`` drain would have — the ``fit(resume="auto")`` fast-forward
+    no longer materializes thousands of throwaway batches.
+    """
+
+    def __init__(
+        self,
+        arrays: Dict[str, np.ndarray],
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+        loop: bool = True,
+    ):
+        self.arrays = dict(arrays)
+        self.n = min(v.shape[0] for v in self.arrays.values())
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+        self.loop = loop
+        self._rng = np.random.default_rng(seed)
+        self._end = (self.n // batch_size) * batch_size if drop_remainder else self.n
+        self._idx: Optional[np.ndarray] = None  # current epoch's permutation
+        self._pos = 0  # row offset into the current epoch
+        self._exhausted = False
+        self.batches_materialized = 0  # gathers performed (skip test hook)
+
+    def __iter__(self) -> "BatchIterator":
+        return self
+
+    def _ensure_epoch(self) -> None:
+        if self._idx is None:
+            self._idx = (
+                self._rng.permutation(self.n)
+                if self.shuffle
+                else np.arange(self.n)
+            )
+            self._pos = 0
+
+    def _advance(self) -> None:
+        """Move past the batch at ``_pos``, rolling the epoch as needed."""
+        self._pos += self.batch_size
+        if self._pos >= self._end:
+            self._idx = None
+            if not self.loop:
+                self._exhausted = True
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        if self._exhausted:
+            raise StopIteration
+        self._ensure_epoch()
+        take = self._idx[self._pos : self._pos + self.batch_size]
+        batch = {k: v[take] for k, v in self.arrays.items()}
+        self.batches_materialized += 1
+        self._advance()
+        return batch
+
+    def skip(self, n: int) -> int:
+        """Advance ``n`` batches by index arithmetic only — no row gathers.
+        Returns how many were skipped (short only on exhaustion)."""
+        skipped = 0
+        while skipped < n and not self._exhausted:
+            self._ensure_epoch()
+            # batches remaining in this epoch from the current offset
+            remaining = len(range(self._pos, self._end, self.batch_size))
+            take = min(n - skipped, remaining)
+            if take < remaining:
+                self._pos += take * self.batch_size
+            else:
+                # cross the epoch boundary through _advance so the loop /
+                # exhaustion rules stay identical to the next() path
+                self._pos += (take - 1) * self.batch_size
+                self._advance()
+            skipped += take
+        return skipped
+
+
 def batch_iterator(
     arrays: Dict[str, np.ndarray],
     batch_size: int,
@@ -35,17 +120,15 @@ def batch_iterator(
     drop_remainder: bool = True,
     loop: bool = True,
 ) -> Iterator[Dict[str, np.ndarray]]:
-    """Infinite (or one-epoch) minibatch iterator over array dicts."""
-    n = min(v.shape[0] for v in arrays.values())
-    rng = np.random.default_rng(seed)
-    while True:
-        idx = rng.permutation(n) if shuffle else np.arange(n)
-        end = (n // batch_size) * batch_size if drop_remainder else n
-        for i in range(0, end, batch_size):
-            take = idx[i : i + batch_size]
-            yield {k: v[take] for k, v in arrays.items()}
-        if not loop:
-            return
+    """Minibatch iterator over array dicts (see :class:`BatchIterator`)."""
+    return BatchIterator(
+        arrays,
+        batch_size,
+        shuffle=shuffle,
+        seed=seed,
+        drop_remainder=drop_remainder,
+        loop=loop,
+    )
 
 
 def synthetic_lm_batches(

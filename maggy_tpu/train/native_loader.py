@@ -135,6 +135,13 @@ class NativeBatchLoader:
         self._lib = _native_lib()
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
         self._stop = threading.Event()
+        # skip(n) bookkeeping: batches are queued tagged with their global
+        # index; the producer skips the gather for indices below _min_index
+        # and the consumer discards any already-materialized stragglers, so
+        # a resume fast-forward costs index arithmetic, not 10k gathers
+        self._next_index = 0  # global index of the next batch the consumer expects
+        self._min_index = 0  # first index the consumer still wants
+        self.gathers = 0  # row gathers performed (skip test hook)
         # the producer holds only a weakref: an un-closed loader that goes out
         # of scope gets collected, and the thread exits instead of pinning the
         # dataset forever
@@ -162,10 +169,28 @@ class NativeBatchLoader:
         return self
 
     def __next__(self) -> Dict[str, np.ndarray]:
-        item = self._queue.get()
-        if item is None:
-            raise StopIteration
-        return item
+        while True:
+            item = self._queue.get()
+            if item is None:
+                raise StopIteration
+            idx, batch = item
+            if idx < self._min_index:
+                continue  # materialized before a skip() landed; discard
+            self._next_index = idx + 1
+            return batch
+
+    def skip(self, n: int) -> int:
+        """Advance the stream ``n`` batches without gathering their rows.
+        The producer's permutation stream is untouched (one draw per epoch
+        either way), so the post-skip sequence is exactly what ``n`` calls
+        of ``next()`` would have left. At most the already-queued/in-flight
+        batches (bounded by ``prefetch + 1``) are materialized wastefully.
+        """
+        if n <= 0:
+            return 0
+        self._next_index += n
+        self._min_index = self._next_index
+        return n
 
     def close(self) -> None:
         self._stop.set()
@@ -184,6 +209,7 @@ class NativeBatchLoader:
 def _producer_loop(loader_ref: "weakref.ref") -> None:
     """Producer body; re-derefs the loader every batch so collection stops it."""
     epoch = 0
+    global_idx = 0  # batch counter across epochs (the skip() coordinate)
     while True:
         loader = loader_ref()
         if loader is None or loader._stop.is_set():
@@ -200,13 +226,22 @@ def _producer_loop(loader_ref: "weakref.ref") -> None:
             loader = loader_ref()
             if loader is None or loader._stop.is_set():
                 return
+            if global_idx < loader._min_index:
+                # skipped range: advance the index, never touch the rows
+                # (a slightly stale _min_index read just gathers one batch
+                # the consumer will discard — the sequence stays exact)
+                global_idx += 1
+                continue
             idx = np.ascontiguousarray(perm[i : i + batch_size])
             batch = {k: loader._gather(v, idx) for k, v in loader.arrays.items()}
+            loader.gathers += 1
+            item = (global_idx, batch)
+            global_idx += 1
             stop = loader._stop
             del loader  # do not hold a strong ref while blocked on the queue
             while not stop.is_set():
                 try:
-                    q.put(batch, timeout=0.1)
+                    q.put(item, timeout=0.1)
                     break
                 except queue.Full:
                     if loader_ref() is None:

@@ -1,0 +1,237 @@
+"""Host→device input prefetcher: the training tier's overlap seam.
+
+``Trainer.fit``'s old loop body did ``shard_batch`` (host gather + H2D
+``device_put``) synchronously between steps, so the device queue drained
+while the host assembled the next batch — the exact serialization the
+communication/computation-overlap literature (Lagom, the TPU concurrency
+study — PAPERS.md) identifies as the first-order loss. The
+:class:`DevicePrefetcher` moves that work onto a background thread that runs
+``depth`` batches ahead: H2D transfer of batch ``i+1`` overlaps compute of
+batch ``i``, and the consumer's per-step cost collapses to a queue pop.
+
+Design notes:
+
+* **put runs in the producer thread.** ``put`` (normally
+  ``Trainer.shard_batch``) issues ``jax.device_put`` against the mesh
+  shardings; JAX dispatch is thread-safe and the resulting arrays are
+  ordinary global arrays by the time the consumer sees them.
+* **Bounded consumption.** ``max_items`` caps how many host batches are ever
+  pulled from ``source`` — ``fit`` passes its step budget, so on the happy
+  path the prefetcher consumes *exactly* as many batches as the synchronous
+  loop would have (iterators shared across consecutive calls keep their
+  position). Only early exits (preemption, chaos, early stop) leave up to
+  ``depth`` extra batches consumed.
+* **Telemetry.** Each placement records a ``shard_batch`` span (same name
+  the synchronous path used) into the recorder handed in by the consumer;
+  the consumer side records ``input_wait_ms`` (time blocked on the queue —
+  ~0 when the pipeline keeps up) and ``prefetch_depth`` (queue occupancy)
+  gauges.
+* **Collection-safe.** Like :class:`NativeBatchLoader`, the producer holds
+  only a weakref to the prefetcher, so an un-closed prefetcher that goes out
+  of scope is collected and its thread exits instead of pinning the source.
+
+:func:`skip_batches` is the resume fast path: it routes ``fit``'s
+``resume="auto"`` fast-forward through a loader's ``skip(n)`` (index
+advance, no data materialization — ``batch_iterator`` and
+``NativeBatchLoader`` implement it) and falls back to draining ``next()``
+for plain generators.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterator, Optional
+
+from maggy_tpu import telemetry
+
+
+def skip_batches(source: Any, n: int) -> int:
+    """Advance ``source`` by ``n`` batches, preferring its ``skip(n)`` fast
+    path (no materialization) over draining ``next()``. Returns how many
+    batches were actually skipped (short on exhaustion)."""
+    if n <= 0:
+        return 0
+    src_skip = getattr(source, "skip", None)
+    if callable(src_skip):
+        out = src_skip(n)
+        return n if out is None else int(out)
+    skipped = 0
+    for _ in range(n):
+        try:
+            next(source)
+        except StopIteration:
+            break
+        skipped += 1
+    return skipped
+
+
+class _Error:
+    """Producer-side exception, relayed to the consumer verbatim."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_END = object()  # end-of-source sentinel
+
+
+class DevicePrefetcher:
+    """Double-buffered host→device iterator over any batch iterator.
+
+    ``for sharded in DevicePrefetcher(loader, trainer.shard_batch): ...``
+    yields device-placed batches in source order while the producer thread
+    stays ``depth`` batches ahead.
+    """
+
+    def __init__(
+        self,
+        source: Iterator,
+        put: Callable[[Any], Any],
+        depth: int = 2,
+        max_items: Optional[int] = None,
+        telemetry_recorder=None,
+    ):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._source = source
+        self._put = put
+        self.depth = depth
+        self.max_items = max_items
+        self._tel = telemetry_recorder or telemetry.get()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._terminal: Any = None  # _END or _Error once the stream finished
+        self.wait_ms_total = 0.0
+        self.consumed = 0
+
+    # ------------------------------------------------------------------ iterate
+
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    @property
+    def started(self) -> bool:
+        return self._thread is not None
+
+    def _start(self) -> None:
+        if self._thread is not None:
+            return
+        # lazy start: skip() before the first __next__ still sees the source
+        # untouched, so the resume fast-forward never races the producer
+        self._thread = threading.Thread(
+            target=_prefetch_loop,
+            args=(weakref.ref(self),),
+            name="maggy-device-prefetch",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def __next__(self):
+        if self._terminal is not None:
+            if isinstance(self._terminal, _Error):
+                raise self._terminal.exc
+            raise StopIteration
+        self._start()
+        self._tel.gauge("prefetch_depth", self._queue.qsize())
+        t0 = time.perf_counter()
+        item = self._queue.get()
+        wait_ms = (time.perf_counter() - t0) * 1e3
+        self.wait_ms_total += wait_ms
+        self._tel.gauge("input_wait_ms", wait_ms)
+        if item is _END:
+            self._terminal = item
+            raise StopIteration
+        if isinstance(item, _Error):
+            self._terminal = item
+            raise item.exc
+        self.consumed += 1
+        return item
+
+    # -------------------------------------------------------------------- skip
+
+    def skip(self, n: int) -> int:
+        """Fast-forward by ``n`` batches. Before the first ``__next__`` this
+        delegates to the source's own ``skip`` (no materialization); after
+        the pipeline started it drains already-placed batches."""
+        if n <= 0:
+            return 0
+        if self._thread is None:
+            return skip_batches(self._source, n)
+        skipped = 0
+        for _ in range(n):
+            try:
+                next(self)
+            except StopIteration:
+                break
+            skipped += 1
+        return skipped
+
+    # ------------------------------------------------------------------- close
+
+    def close(self) -> None:
+        """Stop the producer and drop buffered batches. Idempotent."""
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _enqueue(ref: "weakref.ref", stop, q, item) -> bool:
+    """Blocking bounded put that stays responsive to close() and collection.
+    Caller must NOT hold a strong prefetcher ref across this call."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            if ref() is None:
+                return False
+    return False
+
+
+def _prefetch_loop(ref: "weakref.ref") -> None:
+    """Producer body; re-derefs the prefetcher each batch so collection
+    stops it (same lifecycle idiom as ``native_loader._producer_loop``)."""
+    i = 0
+    terminal = _END
+    while True:
+        pf = ref()
+        if pf is None or pf._stop.is_set():
+            return
+        if pf.max_items is not None and i >= pf.max_items:
+            break
+        try:
+            batch = next(pf._source)
+            with pf._tel.span("shard_batch", step=i):
+                item = pf._put(batch)
+        except StopIteration:
+            break
+        except BaseException as e:  # noqa: BLE001 - relayed to the consumer
+            terminal = _Error(e)
+            break
+        stop, q = pf._stop, pf._queue
+        del pf  # no strong ref while blocked on the bounded queue
+        if not _enqueue(ref, stop, q, item):
+            return
+        i += 1
+    pf = ref()
+    if pf is not None:
+        stop, q = pf._stop, pf._queue
+        del pf
+        _enqueue(ref, stop, q, terminal)

@@ -21,7 +21,9 @@ patching engine classes (SURVEY.md §7 design stance).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -81,6 +83,17 @@ def collect_aux_losses(mods) -> jax.Array:
     return aux
 
 
+def _prefetch_depth(prefetch: Optional[int]) -> int:
+    """Resolve an input-prefetch depth: an explicit argument wins, else the
+    ``MAGGY_TPU_PREFETCH`` env knob, else 2 (double-buffered). 0 disables."""
+    if prefetch is not None:
+        return max(0, int(prefetch))
+    try:
+        return max(0, int(os.environ.get("MAGGY_TPU_PREFETCH", "2")))
+    except ValueError:
+        return 2
+
+
 def _model_inputs(batch: Dict[str, jax.Array]) -> Tuple:
     if "tokens" in batch:
         args = [batch["tokens"]]
@@ -118,6 +131,9 @@ class Trainer:
         self.state_shardings = None
         self._pp_parts = None
         self._pp_built_micro = None
+        # (shape key, shardings) memo so the per-step hot path never
+        # recomputes the batch sharding tree — the spec plumbing runs once
+        self._batch_shardings_memo = None
 
     # ---------------------------------------------------------------- pipeline
 
@@ -317,6 +333,30 @@ class Trainer:
             for k, v in batch.items()
         }
 
+    def _cached_batch_shardings(self, batch):
+        """``batch_shardings`` memoized on the batch's (key, shape, dtype)
+        signature — every step of a training run sees the same signature, so
+        the sharding tree is computed once instead of per step (the
+        shard-spec plumbing the prefetcher keeps off the hot path)."""
+        key = None
+        if isinstance(batch, dict):
+            try:
+                key = tuple(
+                    sorted(
+                        (k, tuple(v.shape), str(v.dtype))
+                        for k, v in batch.items()
+                    )
+                )
+            except (AttributeError, TypeError):  # nested/objects: no memo
+                key = None
+        memo = self._batch_shardings_memo
+        if key is not None and memo is not None and memo[0] == key:
+            return memo[1]
+        shardings = self.batch_shardings(batch)
+        if key is not None:
+            self._batch_shardings_memo = (key, shardings)
+        return shardings
+
     def shard_batch(self, batch, *, local: bool = False):
         """Place a host batch onto the mesh, batch axis over (data, fsdp).
 
@@ -327,7 +367,7 @@ class Trainer:
         already rank-shards its stream (petastorm semantics — reference
         dataloader.py:116-131) passes ``local=True`` to skip the slicing.
         """
-        shardings = self.batch_shardings(batch)
+        shardings = self._cached_batch_shardings(batch)
         if jax.process_count() == 1:
             return jax.device_put(batch, shardings)
         import numpy as np
@@ -586,13 +626,26 @@ class Trainer:
         with self.mesh:
             return self._eval_step(state, batch)
 
-    def evaluate(self, state: TrainState, data_iter, num_batches: int) -> Dict[str, float]:
+    def evaluate(
+        self,
+        state: TrainState,
+        data_iter,
+        num_batches: int,
+        prefetch: Optional[int] = None,
+    ) -> Dict[str, float]:
         """Mean loss over ``num_batches`` held-out batches (no state update).
         The loss is computed inside jit so full logits never leave the
         device. Under pp>1 the loss flows through the pipeline stages
         (forward-only GPipe sweep, VERDICT r4 item 9) — per-device live
         bytes stay bounded by one stage's params + a microbatch activation,
-        never the unstacked full model."""
+        never the unstacked full model.
+
+        Host overlap (docs/performance.md): input batches flow through a
+        :class:`~maggy_tpu.train.prefetch.DevicePrefetcher` (``prefetch``
+        batches ahead; ``MAGGY_TPU_PREFETCH`` sets the default, 0 disables)
+        capped at ``num_batches`` so the iterator is never over-consumed,
+        and the per-batch losses accumulate ON DEVICE — one host sync at
+        the end instead of a pipeline drain per batch."""
         if num_batches < 1:
             raise ValueError("evaluate needs num_batches >= 1")
         if self._eval_loss_step is None:
@@ -626,12 +679,37 @@ class Trainer:
                     return self.loss_fn(logits, batch)
 
             self._eval_loss_step = jax.jit(eval_loss)
-        losses = []
-        with self.mesh:
-            for _ in range(num_batches):
-                batch = self.shard_batch(next(data_iter))
-                losses.append(self._eval_loss_step(state, batch))
-        return {"loss": float(sum(float(l) for l in losses) / num_batches)}
+        from maggy_tpu import telemetry
+        from maggy_tpu.train.prefetch import DevicePrefetcher
+
+        depth = _prefetch_depth(prefetch)
+        prefetcher = (
+            DevicePrefetcher(
+                data_iter,
+                self.shard_batch,
+                depth=depth,
+                max_items=num_batches,
+                telemetry_recorder=telemetry.get(),
+            )
+            if depth > 0
+            else None
+        )
+        total = None
+        try:
+            with self.mesh:
+                for _ in range(num_batches):
+                    if prefetcher is not None:
+                        batch = next(prefetcher)
+                    else:
+                        batch = self.shard_batch(next(data_iter))
+                    loss = self._eval_loss_step(state, batch)
+                    # accumulate on device: no per-batch float() pipeline
+                    # drain — the single conversion below is the only sync
+                    total = loss if total is None else total + loss
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
+        return {"loss": float(total) / num_batches}
 
     def fit(
         self,
@@ -647,6 +725,8 @@ class Trainer:
         profile_dir: Optional[str] = None,
         profile_steps: Tuple[int, int] = (3, 6),
         resume: Optional[Any] = None,
+        prefetch: Optional[int] = None,
+        metrics_window: int = 2,
     ) -> Tuple[TrainState, Dict[str, float]]:
         """Simple host-side loop: shard batch → step → optional reporter
         broadcast at step boundaries (where EarlyStopException can interrupt —
@@ -676,15 +756,39 @@ class Trainer:
         ``metric_sign=-1.0`` so live broadcasts match; there is no implicit
         negation.
 
+        Host overlap (docs/performance.md): with ``prefetch > 0`` (default
+        2; ``MAGGY_TPU_PREFETCH`` overrides, 0 disables) batches flow
+        through a :class:`~maggy_tpu.train.prefetch.DevicePrefetcher` — a
+        background thread runs ``shard_batch`` (host gather + H2D transfer)
+        ``prefetch`` batches ahead, so the device queue never waits on the
+        host input pipeline. Consumption is capped at ``num_steps`` batches,
+        so a shared iterator keeps its position across calls; only early
+        exits (preemption/early stop) may leave up to ``prefetch`` extra
+        batches consumed, and data-wait timing shifts accordingly (a
+        preemption notice raised as a loader side effect fires when the
+        PREFETCHER pulls that batch, up to ``prefetch`` steps early).
+
+        Lagged metrics drain: reporter broadcasts read the metrics ref that
+        just LEFT a ``metrics_window``-deep in-flight window (so the
+        ``float()`` touches a value ``metrics_window`` steps old and never
+        drains the XLA dispatch pipeline), stamped with the step it was
+        measured at. Broadcast values are therefore up to ``metrics_window``
+        steps stale and driver-side early stopping fires up to that many
+        steps later; ``metrics_window=0`` restores synchronous broadcasts.
+        The ``metrics_lag`` gauge records the realized lag.
+
         Telemetry: each step records a ``train_step`` span plus
         ``step_time_ms`` / ``tokens_per_sec`` / ``mfu_est`` gauges into the
         ambient recorder (:func:`maggy_tpu.telemetry.get`; executors install
         a per-worker one), and the first step — synced once to cover the XLA
-        compile — lands in ``compile_time_ms``. The returned metrics dict
-        always carries the measured ``steps_per_sec`` regardless of the
-        telemetry flag. Host wall-clock per later step is measured without
-        extra device syncs (dispatch overlaps; the device queue's
-        backpressure makes the mean converge to true step time).
+        compile — lands in ``compile_time_ms``. The prefetcher adds
+        ``input_wait_ms`` (host time blocked waiting for an input batch) and
+        ``prefetch_depth`` (queue occupancy) gauges, plus the ``shard_batch``
+        spans the synchronous path used to record inline. The returned
+        metrics dict always carries the measured ``steps_per_sec``
+        regardless of the telemetry flag. Host wall-clock per later step is
+        measured without extra device syncs (dispatch overlaps; the device
+        queue's backpressure makes the mean converge to true step time).
         """
         from maggy_tpu import telemetry
         from maggy_tpu.resilience import chaos as _chaos
@@ -710,9 +814,12 @@ class Trainer:
                 skipped = resumed_from - start
                 # fast-forward: the interrupted run consumed one batch per
                 # completed step — skip them so the data stream (and the loss
-                # trajectory) continues where it left off
-                for _ in range(skipped):
-                    next(data_iter)
+                # trajectory) continues where it left off. Loaders with a
+                # skip(n) fast path (batch_iterator, NativeBatchLoader)
+                # advance by index; plain generators drain next().
+                from maggy_tpu.train.prefetch import skip_batches
+
+                skip_batches(data_iter, skipped)
                 tel.count("resilience.auto_resumes")
                 tel.gauge("resumed_step", resumed_from)
         # num_steps is the TOTAL budget for this fit call; a resumed fit only
@@ -722,24 +829,43 @@ class Trainer:
         # only armed when there is a checkpointer to save into
         hook = _preemption.install() if checkpointer is not None else None
         chaos = _chaos.get()
-        base_step = int(state.step) if chaos is not None else 0
+        # host-side step base: every in-loop "current step" below derives
+        # from this + the loop index, so nothing int()s the device-resident
+        # state.step (which would drain the dispatch pipeline)
+        step0 = int(state.step)
         preempted = False
         metrics = {}
         profiling = False
         prof_start = min(profile_steps[0], max(0, num_steps - 2))
         prof_stop = min(profile_steps[1], num_steps - 1)
+        depth = _prefetch_depth(prefetch)
+        prefetcher = None
+        if depth > 0 and num_steps > 0:
+            from maggy_tpu.train.prefetch import DevicePrefetcher
+
+            prefetcher = DevicePrefetcher(
+                data_iter,
+                self.shard_batch,
+                depth=depth,
+                max_items=num_steps,
+                telemetry_recorder=tel,
+            )
+        window = max(0, int(metrics_window))
+        pending: deque = deque()  # (loop index, in-flight device metrics)
+        ready = None  # newest entry aged OUT of the window: safe to sync
+        last_bcast = -1  # last loop index broadcast (monotonic step guard)
         fit_t0 = time.perf_counter()
         tokens_per_batch = 0
         step_ms_sum = 0.0
         try:
-            for i in range(num_steps):
+            for i in range(num_steps):  # hot-loop (tools/check_host_sync.py)
                 if chaos is not None:
                     # deterministic fault injection (chaos harness): a
                     # matching kill rule raises WorkerLost here
-                    chaos.kill(tel.worker, step=base_step + i)
+                    chaos.kill(tel.worker, step=step0 + i)
                 if hook is not None and hook.requested():
                     checkpointer.save(
-                        int(state.step), state, meta=self.checkpoint_meta()
+                        step0 + i, state, meta=self.checkpoint_meta()
                     )
                     checkpointer.wait()
                     tel.count("resilience.preempt_saves")
@@ -748,42 +874,64 @@ class Trainer:
                 if profile_dir is not None and not profiling and i == prof_start:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
-                batch = next(data_iter)
-                if i == 0 and isinstance(batch, dict) and "tokens" in batch:
-                    tokens_per_batch = int(
-                        getattr(batch["tokens"], "size", 0)
-                        or np.asarray(batch["tokens"]).size
+                if prefetcher is not None:
+                    # sharded batches arrive pre-placed; H2D transfer of this
+                    # batch overlapped compute of the previous step
+                    sharded = next(prefetcher)
+                else:
+                    batch = next(data_iter)
+                    with tel.span("shard_batch", step=i):
+                        sharded = self.shard_batch(batch)
+                if i == 0 and isinstance(sharded, dict) and "tokens" in sharded:
+                    tokens_per_batch = int(  # sync: ok — shape metadata, not device data
+                        getattr(sharded["tokens"], "size", 0)
                     )
                 t0 = time.perf_counter()
-                with tel.span("shard_batch", step=i):
-                    sharded = self.shard_batch(batch)
                 with tel.span("train_step", step=i):
                     state, metrics = self.step(state, sharded)
                     if i == 0 and tel.active:
                         # one deliberate sync so the first sample covers the
                         # XLA compile; later steps stay fully async
-                        jax.block_until_ready(metrics)
+                        jax.block_until_ready(metrics)  # sync: ok — compile timing
                 dt_ms = (time.perf_counter() - t0) * 1e3
                 if i == 0:
                     tel.gauge("compile_time_ms", dt_ms)
                 else:
                     step_ms_sum += dt_ms
                     tel.gauge("step_time_ms", dt_ms)
+                # lagged metrics window: refs sit here `window` steps before
+                # anything host-reads them, so broadcasts touch only results
+                # the device has long finished — never the dispatch frontier
+                pending.append((i, metrics))
+                while len(pending) > max(1, window):
+                    ready = pending.popleft()
                 if profiling and i >= prof_stop:
-                    jax.block_until_ready(metrics)
+                    jax.block_until_ready(metrics)  # sync: ok — trace boundary
                     jax.profiler.stop_trace()
                     profiling = False
                     profile_dir = None  # one capture per fit
                 if reporter is not None and (i + 1) % report_every == 0:
-                    value = metric_sign * float(metrics[metric_key])
-                    reporter.broadcast(value, step=int(state.step))
+                    # window 0 = synchronous broadcasts (fresh value, full
+                    # pipeline drain); otherwise read the entry that aged
+                    # out of the window
+                    src = pending[-1] if window == 0 else ready
+                    if (src is None or src[0] <= last_bcast) and i == num_steps - 1:
+                        src = pending[0]  # final boundary: window not primed
+                    if src is not None and src[0] > last_bcast:
+                        j, lagged = src
+                        last_bcast = j
+                        tel.gauge("metrics_lag", i - j)
+                        value = metric_sign * float(lagged[metric_key])  # sync: ok — ref aged out of the window
+                        reporter.broadcast(value, step=step0 + j + 1)
                 if checkpointer is not None and checkpoint_every and (
                     (i + 1) % checkpoint_every == 0
                 ):
                     checkpointer.save(
-                        int(state.step), state, meta=self.checkpoint_meta()
+                        step0 + i + 1, state, meta=self.checkpoint_meta()
                     )
         finally:
+            if prefetcher is not None:
+                prefetcher.close()
             if profiling:  # loop ended/raised while a trace was active
                 jax.profiler.stop_trace()
         out = {k: float(v) for k, v in metrics.items()}

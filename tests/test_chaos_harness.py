@@ -160,8 +160,12 @@ def test_fit_preemption_saves_and_resumes(tmp_path):
     ckpt = Checkpointer(str(tmp_path / "ck"), async_save=False)
     tel = telemetry.Telemetry(worker="t", role="test")
     with telemetry.current(tel):
+        # prefetch=0: the notice fires as a loader side effect, so its
+        # arrival step is only deterministic on the synchronous input path
+        # (the prefetcher would pull — and trigger — it a couple of steps
+        # early); prefetch interplay is covered in test_prefetch.py
         state, out = trainer.fit(
-            state, noisy(data, 3), num_steps=6, checkpointer=ckpt,
+            state, noisy(data, 3), num_steps=6, checkpointer=ckpt, prefetch=0,
         )
     assert out["preempted"] == 1.0
     # the notice arrives while step 4's batch is being fetched, so fit honors

@@ -240,6 +240,89 @@ def test_default_rng_warns_when_sampling(served):
         generate_cached(decode_model, params, buf, plen)  # greedy: silent
 
 
+# ------------------------------------------------------------- async decode
+
+
+def test_async_decode_matches_sync_engine(served):
+    """ACCEPTANCE (ISSUE 5): the async double-buffered drain (decode i+1
+    dispatched before host-reading step i) produces byte-identical token
+    streams to the synchronous engine — greedy AND sampled — under
+    staggered admissions, and the decode step still compiles once."""
+    _, params = served
+
+    def run(async_decode, temp):
+        engine = Engine(CFG, params, num_slots=3, async_decode=async_decode)
+        scheduler = Scheduler(engine)
+        scheduler.start()
+        try:
+            prompts = [[1, 2, 3, 4], [5, 6, 7], [9, 10, 11, 12, 13], [2, 4, 6], [7, 3]]
+            reqs = []
+            for i, p in enumerate(prompts):
+                reqs.append(
+                    scheduler.submit(
+                        p,
+                        SamplingParams(
+                            max_new=4 + (i % 3), temperature=temp, seed=17 + i
+                        ),
+                    )
+                )
+                time.sleep(0.01)  # staggered -> admissions interleave decode
+            run_all(scheduler, reqs)
+        finally:
+            scheduler.stop()
+        assert all(r.state == "done" for r in reqs), [
+            (r.state, r.error) for r in reqs
+        ]
+        return [list(r.tokens) for r in reqs], engine
+
+    for temp in (0.0, 0.8):
+        sync_streams, _ = run(False, temp)
+        async_streams, engine = run(True, temp)
+        assert sync_streams == async_streams, f"temp={temp}: streams diverge"
+        assert engine.compile_counts["decode"] == 1, engine.compile_counts
+
+
+def test_async_flush_discards_post_finish_garbage(served):
+    """Direct engine drive: the one extra step a slot decodes before the
+    host learns it finished is discarded at drain — a released/re-admitted
+    slot never leaks a stale token, and flush() retires the pending
+    dispatch when the active set empties."""
+    _, params = served
+    tel = __import__("maggy_tpu").telemetry.Telemetry(worker="t")
+    engine = Engine(
+        CFG, params, num_slots=1, async_decode=True, telemetry_recorder=tel
+    )
+    slot, first = engine.admit(
+        Request(prompt=[1, 2, 3], params=SamplingParams(max_new=4))
+    )
+    toks = [first]
+    # decode: output lags dispatch by one step — first step returns nothing
+    out = engine.step()
+    assert out.tokens == {}
+    while len(toks) < 4:
+        out = engine.step()
+        toks.extend(out.tokens.values())
+    engine.release(slot)
+    # the pending dispatch still references the released slot: its token
+    # belongs to no one and must vanish
+    leftover = engine.flush()
+    assert leftover.tokens == {}
+    assert engine.flush().tokens == {}  # idempotent
+    engine.slots.check_invariants()
+    # re-admission into the same slot starts a fresh stream that matches the
+    # no-churn reference (stale pending state must not bleed through)
+    slot2, first2 = engine.admit(
+        Request(prompt=[1, 2, 3], params=SamplingParams(max_new=4))
+    )
+    toks2 = [first2]
+    while len(toks2) < 4:
+        toks2.extend(engine.step().tokens.values())
+    engine.release(slot2)
+    engine.flush()
+    assert toks2 == toks == list(reference(params, [1, 2, 3], 4))
+    assert "serve.drain_ms" in tel.snapshot()["gauges"]
+
+
 # ------------------------------------------------------------------- limits
 
 

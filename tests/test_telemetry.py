@@ -208,9 +208,11 @@ def test_profiler_hook_starts_and_stops_at_bounds(monkeypatch, tmp_path):
     fake = _FakeProfiler(counter)
     monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
     monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    # prefetch=0: the draw-count assertion below pins when batches leave the
+    # iterator, which only the synchronous input path makes deterministic
     trainer.fit(
         state, _counting(data, counter), num_steps=6,
-        profile_dir=str(tmp_path), profile_steps=(1, 3),
+        profile_dir=str(tmp_path), profile_steps=(1, 3), prefetch=0,
     )
     # started before step profile_steps[0]'s batch was drawn...
     assert fake.starts == [(str(tmp_path), 1)]

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Lint: ban host↔device synchronization inside annotated hot loops.
+
+The overlap subsystem (docs/performance.md) only works while nothing in a
+hot loop forces the XLA dispatch pipeline to drain: one stray ``float()`` on
+a fresh device metric re-serializes host and device and silently costs the
+whole prefetch/async-drain win. This lint makes that property durable:
+
+* A ``for``/``while``/``def`` line carrying a ``# hot-loop`` comment marks
+  its body as a device-hot region.
+* Inside a region, calls that typically force a device→host sync are
+  flagged: ``float(...)``, ``int(...)``, ``<x>.item()``, and
+  ``np.asarray(...)`` / ``numpy.asarray(...)``.
+* A deliberate, bounded sync (e.g. the lagged metrics drain reading a ref
+  that is already ``window`` steps old, or compile-time measurement) is
+  allowlisted per-site with a ``# sync: ok`` comment on any line the call
+  spans — ideally with a reason after it.
+
+The lint is syntactic, not type-aware: it flags ``int()`` of plain Python
+values too. That is intentional — a hot loop should not need conversions at
+all, and the annotation cost of a justified ``# sync: ok`` is one comment.
+
+``REQUIRED_REGIONS`` pins the two loops the overlap PR rebuilt —
+``Trainer.fit``'s step loop and ``Engine.step`` — so deleting the marker
+(and with it the protection) is itself a violation.
+
+Usage: ``python tools/check_host_sync.py [root]`` — exits nonzero listing
+violations. Wired into the tier-1 run via ``tests/test_prefetch.py``,
+beside the exception-hygiene, bare-print, and docs-nav lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, List, Set, Tuple
+
+HOT_MARKER = re.compile(r"#\s*hot-loop")
+OK_MARKER = re.compile(r"#\s*sync:\s*ok")
+
+# (path suffix, function name) pairs that MUST contain a hot-loop region
+REQUIRED_REGIONS: Tuple[Tuple[str, str], ...] = (
+    (os.path.join("maggy_tpu", "train", "trainer.py"), "fit"),
+    (os.path.join("maggy_tpu", "serve", "engine.py"), "step"),
+)
+
+
+def _comment_lines(source: str) -> Dict[int, str]:
+    """line -> comment text, tolerating partial tokenization."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(iter(source.splitlines(True)).__next__):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _sync_call(node: ast.Call) -> str:
+    """Name of the flagged sync pattern ``node`` matches, or ''."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in ("float", "int"):
+        return f"{fn.id}()"
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "item":
+            return ".item()"
+        if fn.attr == "asarray" and isinstance(fn.value, ast.Name) and fn.value.id in (
+            "np",
+            "numpy",
+        ):
+            return f"{fn.value.id}.asarray()"
+    return ""
+
+
+def find_violations(source: str, path: str) -> List[Tuple[int, str]]:
+    """(line, description) for every unjustified sync in a hot region."""
+    tree = ast.parse(source, filename=path)
+    comments = _comment_lines(source)
+    hot_lines: Set[int] = {
+        ln for ln, text in comments.items() if HOT_MARKER.search(text)
+    }
+    ok_lines: Set[int] = {
+        ln for ln, text in comments.items() if OK_MARKER.search(text)
+    }
+    regions: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.For, ast.While, ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and node.lineno in hot_lines:
+            regions.append((node.lineno, node.end_lineno or node.lineno))
+    out: List[Tuple[int, str]] = []
+    if not regions:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        what = _sync_call(node)
+        if not what:
+            continue
+        if not any(lo <= node.lineno <= hi for lo, hi in regions):
+            continue
+        span = range(node.lineno, (node.end_lineno or node.lineno) + 1)
+        if any(ln in ok_lines for ln in span):
+            continue
+        out.append(
+            (
+                node.lineno,
+                f"{what} inside a hot-loop region forces a host sync — "
+                "move it out of the loop or justify with '# sync: ok'",
+            )
+        )
+    return out
+
+
+def has_hot_region(source: str, path: str, func_name: str) -> bool:
+    """True when ``func_name`` in ``source`` contains a hot-loop marker."""
+    tree = ast.parse(source, filename=path)
+    comments = _comment_lines(source)
+    hot_lines = {ln for ln, text in comments.items() if HOT_MARKER.search(text)}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == func_name:
+            if any(node.lineno <= ln <= (node.end_lineno or node.lineno) for ln in hot_lines):
+                return True
+    return False
+
+
+def check_tree(root: str) -> List[Tuple[str, int, str]]:
+    violations: List[Tuple[str, int, str]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))
+        ]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            try:
+                hits = find_violations(source, path)
+            except SyntaxError as e:
+                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
+                continue
+            violations.extend((path, line, what) for line, what in hits)
+            for suffix, func in REQUIRED_REGIONS:
+                if path.endswith(suffix) and not has_hot_region(source, path, func):
+                    violations.append(
+                        (
+                            path,
+                            0,
+                            f"required hot-loop marker missing from {func}() — "
+                            "the overlap hot path lost its lint protection",
+                        )
+                    )
+    return violations
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = args[0] if args else os.path.join(repo, "maggy_tpu")
+    violations = check_tree(root)
+    for path, line, what in violations:
+        print(f"{path}:{line}: {what}", file=sys.stderr)
+    if violations:
+        print(f"{len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
