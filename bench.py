@@ -477,6 +477,89 @@ def bench_serve_drain(quick: bool = False):
     }
 
 
+def bench_fleet(quick: bool = False):
+    """Serving fleet (maggy_tpu/serve/fleet, ISSUE 6): aggregate tok/s and
+    TTFT p50/p95 at a FIXED offered load through the router with N=1 vs N=2
+    replicas, on a shared-system-prompt workload so prefix-KV reuse fires —
+    the prefix-hit ratio is the single-engine win, the N=2/N=1 throughput
+    ratio is the scale-out win. CPU-mesh safe (tiny decoder, in-process
+    replicas)."""
+    import jax
+    import jax.numpy as jnp
+
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.parallel.sharding import unbox
+    from maggy_tpu.serve import ServeClient
+    from maggy_tpu.serve.fleet import ReplicaSpec, launch_fleet
+
+    cfg = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+    params = unbox(
+        Decoder(cfg).init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    # offered load chosen to SATURATE the per-replica slots (tiny-decoder
+    # service time ~tens of ms): requests must overlap or there is nothing
+    # for prefix reuse to hit and no queue for admission to manage
+    n_requests = 8 if quick else 16
+    offered_rps = 100.0
+    max_new = 32
+    system_prompt = [7, 3, 9, 4, 2, 8, 6, 1, 5, 9, 3, 7]  # shared prefix
+
+    def run(n_replicas):
+        spec = ReplicaSpec(cfg, params, num_slots=2)
+        router = launch_fleet(spec, replicas=n_replicas)
+        host, port = router.start(host="127.0.0.1")
+        try:
+            with ServeClient((host, port), router.secret) as client:
+                # warm every replica's compiles before the measured window
+                # (round-robin tie-break spreads the warmups across the fleet)
+                warm = [
+                    client.submit(system_prompt + [99, 98], max_new=2)
+                    for _ in range(n_replicas)
+                ]
+                for r in warm:
+                    client.result(r, timeout=180)
+                t0 = time.perf_counter()
+                rids = []
+                for i in range(n_requests):
+                    rids.append(
+                        client.submit(
+                            system_prompt + [10 + i, 11 + (i % 5)],
+                            max_new=max_new,
+                        )
+                    )
+                    time.sleep(1.0 / offered_rps)
+                snaps = [client.result(r, timeout=180) for r in rids]
+                wall = time.perf_counter() - t0
+                stats = client.stats()
+        finally:
+            router.stop()
+        done = sum(s["state"] == "done" for s in snaps)
+        admits = max(1, stats.get("prefix_hits", 0) + stats.get("prefill_calls", 0))
+        return {
+            "completed": done,
+            "wall_s": round(wall, 3),
+            "tok_per_sec": round(done * max_new / wall, 1),
+            "ttft_ms_p50": stats.get("ttft_ms_p50"),
+            "ttft_ms_p95": stats.get("ttft_ms_p95"),
+            "prefix_hit_ratio": round(stats.get("prefix_hits", 0) / admits, 3),
+            "prefix_tokens_saved": stats.get("prefix_tokens_saved", 0),
+            "requeued": stats["routing"]["requeued"],
+        }
+
+    one = run(1)
+    two = run(2)
+    return {
+        "n_requests": n_requests,
+        "offered_rps": offered_rps,
+        "max_new": max_new,
+        "n1": one,
+        "n2": two,
+        "scaleout_speedup": round(
+            two["tok_per_sec"] / max(one["tok_per_sec"], 1e-9), 3
+        ),
+    }
+
+
 def bench_autotune(quick: bool = False):
     """Autotune provenance (maggy_tpu/tune): run the static AOT stage over a
     small mesh/batch grid for the tiny decoder and record what the tuner
@@ -584,6 +667,7 @@ def main():
         autotune_stats = None
         input_pipeline_stats = None
         serve_drain_stats = None
+        fleet_stats = None
     else:
         asha_stats = bench_asha_trials_per_hour(quick=args.quick)
         try:
@@ -606,6 +690,10 @@ def main():
             serve_drain_stats = bench_serve_drain(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
             serve_drain_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            fleet_stats = bench_fleet(quick=args.quick)
+        except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
+            fleet_stats = {"error": f"{type(e).__name__}: {e}"}
 
     def rnd(v, digits):
         return None if v is None else round(v, digits)
@@ -631,6 +719,7 @@ def main():
             "autotune": autotune_stats,
             "input_pipeline": input_pipeline_stats,
             "serve_drain": serve_drain_stats,
+            "fleet": fleet_stats,
             "tuned": tuned or None,
         },
     }

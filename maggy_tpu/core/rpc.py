@@ -38,6 +38,7 @@ from maggy_tpu import constants
 from maggy_tpu.exceptions import (
     ReservationTimeoutError,
     RpcError,
+    RpcRejectedError,
 )
 from maggy_tpu.resilience import chaos as chaos_mod
 
@@ -415,10 +416,12 @@ class Client:
                 if tel is not None:
                     tel.rpc(verb, (time.perf_counter() - t0) * 1e3)
                 if reply.get("type") == "ERR":
-                    raise RpcError(f"Driver rejected message: {reply.get('error')}")
+                    raise RpcRejectedError(
+                        f"Driver rejected message: {reply.get('error')}"
+                    )
                 return reply
             except (OSError, RpcError) as e:
-                if isinstance(e, RpcError) and "rejected" in str(e):
+                if isinstance(e, RpcRejectedError):
                     raise
                 if tel is not None:
                     tel.rpc(verb, None, ok=False)
@@ -435,6 +438,11 @@ class Client:
                 except RpcError:
                     pass
         raise RpcError(f"Request {msg.get('type')} failed after retries: {last_err}")
+
+    # public alias: non-worker callers (serve client/router, monitor) speak
+    # ad-hoc verbs over the same socket discipline — give them a supported
+    # name instead of the private underscore
+    request = _request
 
     # ------------------------------------------------------------------ verbs
 

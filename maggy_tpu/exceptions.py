@@ -90,6 +90,20 @@ class RpcError(MaggyError):
     """Control-plane transport failure (connect/auth/framing)."""
 
 
+class RpcRejectedError(RpcError):
+    """The server understood the frame and refused it (ERR reply: bad
+    secret, unknown verb, handler-raised validation error). Never retried —
+    resending the same message gets the same answer — unlike the transport
+    failures its parent covers, which reconnect-and-retry."""
+
+
+class ServerBusyError(RpcError):
+    """429-style admission shed: the serving router projected TTFT past the
+    configured SLO (or has no healthy replica) and declined the request
+    instead of queueing it. Transient by nature — back off and resubmit
+    (``ServeClient.submit(retry_busy=...)`` does it with the rpc jitter)."""
+
+
 class WorkerLost(MaggyError):
     """The worker hosting in-flight work died out from under it (preemption,
     host loss, chaos kill). A TRANSIENT failure by definition: the runtime

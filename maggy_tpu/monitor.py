@@ -151,6 +151,14 @@ def _telemetry_lines(status: dict, width: int) -> list:
             parts.append(f"drain {g['serve.drain_ms']:.1f}ms")
         if "serve.decode_retraces" in g:
             parts.append(f"compiles {g['serve.decode_retraces']:.0f}")
+        if "fleet.healthy_replicas" in g:
+            parts.append(f"healthy {g['fleet.healthy_replicas']:.0f}")
+        c0 = snap.get("counters") or {}
+        if "serve.prefix_hits" in c0:
+            parts.append(
+                f"prefix {c0['serve.prefix_hits']}/"
+                f"{c0.get('serve.prefix_tokens_saved', 0)}tok"
+            )
         # autotuner progress (maggy_tpu/tune): candidate grid, AOT prunes,
         # and the best measured step time so far
         if "tune.candidates" in g:
@@ -237,6 +245,55 @@ def render_status(status: dict, width: int = 78) -> str:
         if tail:
             lines.append(f"-- {status.get('controller', 'controller')} decisions --")
             lines.extend(line[:width] for line in tail[-8:])
+    elif status.get("fleet") is not None:
+        # serving fleet panel (maggy_tpu/serve/fleet Router STATUS verb):
+        # aggregate line + routing counters + one row per replica
+        sv = status.get("serve") or {}
+        fleet = status["fleet"]
+        routing = fleet.get("routing") or {}
+        lines.append(
+            f"fleet: queue={sv.get('queue_depth', 0)}"
+            f"  done={sv.get('requests_done', 0)}"
+            f"  routed={routing.get('routed', 0)}"
+            f"  requeued={routing.get('requeued', 0)}"
+            f"  shed={routing.get('shed', 0)}"
+            f"  respawned={routing.get('respawned', 0)}"
+            + (f"  {elapsed:.0f}s" if elapsed is not None else "")
+        )
+        agg = []
+        if sv.get("prefix_hits"):
+            agg.append(
+                f"prefix hits {sv['prefix_hits']} "
+                f"({sv.get('prefix_tokens_saved', 0)} tok saved)"
+            )
+        if sv.get("ttft_ms_p50") is not None:
+            agg.append(f"ttft p50 {sv['ttft_ms_p50']:.0f}ms")
+        if sv.get("ttft_ms_p95") is not None:
+            agg.append(f"p95 {sv['ttft_ms_p95']:.0f}ms")
+        if agg:
+            lines.append("  ".join(agg)[:width])
+        for row in fleet.get("replicas") or []:
+            bar = util.progress_bar(
+                row.get("active_slots", 0), max(row.get("num_slots", 1), 1),
+                width=10,
+            )
+            tag = {"up": "up", "quarantined": "QUAR", "dead": "DEAD"}.get(
+                row.get("state"), row.get("state", "?")
+            )
+            lines.append(
+                (
+                    f"  r{row.get('replica', '?')} [{tag:>4}] slots {bar}"
+                    f"  queue={row.get('queue_depth', 0)}"
+                    f"  done={row.get('requests_done', 0)}"
+                    f"  prefix={row.get('prefix_hits', 0)}"
+                    + (
+                        f"  restarts={row['restarts']}"
+                        if row.get("restarts")
+                        else ""
+                    )
+                )[:width]
+            )
+        lines.extend(_telemetry_lines(status, width))
     elif status.get("serve") is not None:
         # serving engine panel (maggy_tpu/serve ServeServer STATUS verb)
         sv = status["serve"]

@@ -20,6 +20,10 @@ Seams (all zero-cost when no plan is installed):
   chaos is a test harness, never production instrumentation).
 * :func:`truncate_checkpoint` corrupts a saved step in place so the
   ``Checkpointer.restore`` fallback path can be exercised.
+* The serving fleet router consults ``replica_kill`` — the matching
+  replica's RPC port closes and its scheduler is abandoned mid-decode,
+  simulating a preempted serving host (the router must requeue its
+  in-flight requests to survivors; docs/fleet.md).
 
 Activation: install programmatically (``chaos.install(Chaos.parse(spec))``)
 or via ``MAGGY_TPU_CHAOS=<spec>`` in the environment — the env seam reaches
@@ -126,6 +130,12 @@ class Chaos:
         """Seconds to stall the reply to ``verb`` (0.0 = no stall)."""
         fault = self.fire("rpc_stall", verb=verb)
         return fault.arg if fault is not None else 0.0
+
+    def replica_kill(self, replica: Any) -> bool:
+        """True when this serving replica should drop dead (the fleet
+        router's pump consults it only while the replica is mid-stream, so
+        a matching rule always exercises requeue-to-survivors)."""
+        return self.fire("replica_kill", replica=replica) is not None
 
 
 def truncate_checkpoint(directory: str, step: Optional[int] = None) -> int:

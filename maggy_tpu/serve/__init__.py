@@ -15,10 +15,14 @@ cancellation, and an RPC front-end + client on the
     tokens = client.generate([1, 2, 3], max_new=16)
 
 In-process use (no sockets): build ``Engine`` + ``Scheduler`` directly.
+Multi-replica serving: :mod:`maggy_tpu.serve.fleet` puts an SLO-aware
+router over N of these stacks behind the same verb set
+(``python -m maggy_tpu.serve --replicas 2``; docs/fleet.md).
 """
 
 from maggy_tpu.serve.client import ServeClient  # noqa: F401
 from maggy_tpu.serve.engine import Engine  # noqa: F401
+from maggy_tpu.serve.prefix import PrefixIndex  # noqa: F401
 from maggy_tpu.serve.request import Request, SamplingParams  # noqa: F401
 from maggy_tpu.serve.scheduler import Scheduler  # noqa: F401
 from maggy_tpu.serve.server import ServeServer  # noqa: F401
@@ -26,6 +30,7 @@ from maggy_tpu.serve.slots import SlotManager  # noqa: F401
 
 __all__ = [
     "Engine",
+    "PrefixIndex",
     "Scheduler",
     "ServeServer",
     "ServeClient",

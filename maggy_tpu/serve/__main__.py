@@ -3,6 +3,8 @@
     python -m maggy_tpu.serve --config tiny --slots 8
     python -m maggy_tpu.serve --config llama3_8b --checkpoint /ckpts/run7 \
         --mesh fsdp --slots 16 --port 7777
+    # fleet mode: router + N engine replicas behind one address
+    python -m maggy_tpu.serve --config tiny --replicas 2 --slo-ttft-ms 2000
 
 Without ``--checkpoint`` the model is randomly initialized (``--seed``) — the
 demo/smoke path. The process prints the address and experiment secret on
@@ -87,6 +89,17 @@ def main(argv=None) -> int:
     parser.add_argument("--exp-dir",
                         help="directory for telemetry JSONL export")
     parser.add_argument("--name", default="maggy-serve")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help=">1 serves a fleet: a router front-end over N "
+                             "engine replicas (docs/fleet.md)")
+    parser.add_argument("--slo-ttft-ms", type=float,
+                        help="fleet admission SLO: shed/queue requests whose "
+                             "projected TTFT exceeds this")
+    parser.add_argument("--admission", choices=("queue", "shed"),
+                        default="queue",
+                        help="fleet behavior when projection exceeds the SLO")
+    parser.add_argument("--max-restarts", type=int, default=1,
+                        help="fleet-wide replica respawn budget")
     args = parser.parse_args(argv)
 
     from maggy_tpu.models import Decoder
@@ -134,14 +147,40 @@ def main(argv=None) -> int:
     tel = None
     if args.exp_dir:
         tel = worker_telemetry("serve", args.exp_dir, role="serve")
-    engine = Engine(
-        cfg, params, num_slots=args.slots, mesh=mesh, telemetry_recorder=tel
-    )
-    scheduler = Scheduler(engine)
-    server = ServeServer(scheduler, secret=args.secret, name=args.name)
-    host, port = server.start(host=args.host, port=args.port)
+    if args.replicas > 1:
+        from maggy_tpu.serve.fleet import ReplicaSpec, launch_fleet
+
+        tel_factory = None
+        if args.exp_dir:
+            tel_factory = lambda i: worker_telemetry(  # noqa: E731
+                f"replica{i}", args.exp_dir, role="serve"
+            )
+        spec = ReplicaSpec(
+            cfg, params, num_slots=args.slots, mesh=mesh,
+            telemetry_factory=tel_factory,
+        )
+        server = launch_fleet(
+            spec,
+            replicas=args.replicas,
+            secret=args.secret,
+            name=args.name,
+            slo_ttft_ms=args.slo_ttft_ms,
+            admission=args.admission,
+            max_restarts=args.max_restarts,
+            telemetry_recorder=tel,
+        )
+        host, port = server.start(host=args.host, port=args.port)
+        what = f"fleet router ({args.replicas} replicas)"
+    else:
+        engine = Engine(
+            cfg, params, num_slots=args.slots, mesh=mesh, telemetry_recorder=tel
+        )
+        scheduler = Scheduler(engine)
+        server = ServeServer(scheduler, secret=args.secret, name=args.name)
+        host, port = server.start(host=args.host, port=args.port)
+        what = "engine"
     print(
-        f"[serve] listening on {host}:{port}\n"
+        f"[serve] {what} listening on {host}:{port}\n"
         f"[serve] secret: {server.secret}\n"
         f"[serve] monitor: python -m maggy_tpu.monitor {host}:{port} "
         f"{server.secret} --dashboard",

@@ -2,13 +2,27 @@
 
 Thin wrapper over the control-plane :class:`maggy_tpu.core.rpc.Client`
 (framed JSON, secret-authenticated, auto-reconnect) speaking the serving
-verbs. One socket per client; safe to use from multiple threads (the
+verbs against a single engine OR a fleet router — the verb set is
+identical. One socket per client; safe to use from multiple threads (the
 underlying client serializes the main socket).
 
     client = ServeClient((host, port), secret)
     rid = client.submit([1, 2, 3], max_new=8)
     result = client.result(rid, timeout=30)   # poll until terminal
     print(result["tokens"])
+
+**Failover (default):** a transport-level failure (connection loss, server
+restart) is retried with the control plane's jittered backoff instead of
+raised on first error — the transparent-failover contract the fleet needs:
+a replica dying mid-request surfaces to a polling client only as a
+``state="requeued"`` snapshot, never an exception, and a briefly
+unreachable router heals under the same backoff. Note SUBMIT retries are
+at-least-once: a submit whose reply was lost may have landed, so a retried
+submit can duplicate work (never corrupt it — requests are independent).
+Rejections (validation errors) and 429-style ``BUSY`` sheds are typed
+(:class:`~maggy_tpu.exceptions.RpcRejectedError` /
+:class:`~maggy_tpu.exceptions.ServerBusyError`) and never retried unless
+``submit(retry_busy=N)`` asks for BUSY re-tries.
 """
 
 from __future__ import annotations
@@ -16,13 +30,60 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from maggy_tpu import constants
 from maggy_tpu.core import rpc
-from maggy_tpu.exceptions import RpcError
+from maggy_tpu.exceptions import RpcError, RpcRejectedError, ServerBusyError
 
 
 class ServeClient:
-    def __init__(self, server_addr: Tuple[str, int], secret: str):
+    def __init__(
+        self,
+        server_addr: Tuple[str, int],
+        secret: str,
+        failover: bool = True,
+        max_retries: Optional[int] = None,
+    ):
         self._client = rpc.Client(tuple(server_addr), partition_id=-1, secret=secret)
+        self.failover = failover
+        self.max_retries = (
+            constants.RPC_MAX_RETRIES if max_retries is None else int(max_retries)
+        )
+
+    def _call(self, msg: Dict[str, Any], retry_busy: int = 0) -> Dict[str, Any]:
+        """One verb round-trip with the failover ladder: transport errors
+        (the rpc client already reconnect-retried underneath) get the same
+        jittered backoff again up to ``max_retries``; BUSY replies retry
+        only within the caller's ``retry_busy`` budget; rejections raise
+        immediately."""
+        attempts = max(1, self.max_retries if self.failover else 1)
+        busy_left = int(retry_busy)
+        last_err: Optional[Exception] = None
+        attempt = 0
+        while attempt < attempts:
+            try:
+                reply = self._client.request(msg)
+            except RpcRejectedError:
+                raise
+            except (RpcError, OSError) as e:
+                last_err = e
+                attempt += 1
+                if attempt >= attempts:
+                    break
+                time.sleep(rpc._retry_delay(attempt - 1))
+                continue
+            if reply.get("type") == "BUSY":
+                if busy_left <= 0:
+                    raise ServerBusyError(
+                        f"server busy: {reply.get('error')} "
+                        f"(projected_ttft_ms={reply.get('projected_ttft_ms')})"
+                    )
+                busy_left -= 1
+                time.sleep(float(reply.get("retry_after_s") or 0.25))
+                continue  # BUSY retries don't consume transport attempts
+            return reply
+        raise RpcError(
+            f"{msg.get('type')} failed after {attempts} attempt(s): {last_err}"
+        )
 
     def submit(
         self,
@@ -34,8 +95,9 @@ class ServeClient:
         eos_id: int = -1,
         seed: int = 0,
         deadline_s: Optional[float] = None,
+        retry_busy: int = 0,
     ) -> str:
-        reply = self._client._request(
+        reply = self._call(
             {
                 "type": "SUBMIT",
                 "prompt": [int(t) for t in prompt],
@@ -45,17 +107,20 @@ class ServeClient:
                 "eos_id": eos_id,
                 "seed": seed,
                 "deadline_s": deadline_s,
-            }
+            },
+            retry_busy=retry_busy,
         )
         return reply["id"]
 
     def poll(self, request_id: str) -> Dict[str, Any]:
-        return self._client._request({"type": "POLL", "id": request_id})
+        return self._call({"type": "POLL", "id": request_id})
 
     def result(
         self, request_id: str, timeout: float = 60.0, poll_interval: float = 0.01
     ) -> Dict[str, Any]:
-        """Poll until the request reaches a terminal state."""
+        """Poll until the request reaches a terminal state. A fleet request
+        whose replica died reports ``state="requeued"`` in between — keep
+        polling; the router re-runs it on a survivor under the same id."""
         deadline = time.time() + timeout
         while True:
             snap = self.poll(request_id)
@@ -79,14 +144,10 @@ class ServeClient:
         return list(snap["tokens"])
 
     def cancel(self, request_id: str) -> bool:
-        return bool(
-            self._client._request({"type": "CANCEL", "id": request_id}).get(
-                "cancelled"
-            )
-        )
+        return bool(self._call({"type": "CANCEL", "id": request_id}).get("cancelled"))
 
     def stats(self) -> Dict[str, Any]:
-        return self._client._request({"type": "SSTATS"})
+        return self._call({"type": "SSTATS"})
 
     def close(self) -> None:
         self._client.stop()

@@ -27,6 +27,18 @@ The per-row cache index (models/transformer.py ``_cached_attention``) is
 what makes this work: slots sit at different sequence positions inside one
 compiled program.
 
+**Prefix-KV reuse (default; docs/fleet.md):** real traffic shares long
+prompt prefixes (system prompts are identical across most requests). When a
+new prompt shares a prefix of at least ``prefix_min`` tokens with a
+*resident* slot's prompt (:class:`maggy_tpu.serve.prefix.PrefixIndex`), the
+engine admits it with one compiled admit-from-prefix program: the source
+row's already-computed KV rows ``[0, L)`` are copied device-side into a
+fresh row (exact — for a shared prefix every layer input, and therefore
+every cached K/V projection, is identical), only the suffix is prefilled
+(positions ``L..plen``), and the row is written into the free slot with the
+usual per-row index pin. Outputs are byte-identical to a full prefill;
+``prefix_hits`` / ``prefix_tokens_saved`` counters prove the saved work.
+
 **Async decode (default; docs/performance.md):** ``step()`` dispatches
 decode step ``i+1`` BEFORE host-reading step ``i``'s sampled tokens.
 Continuing slots take their input token straight from the in-flight device
@@ -56,6 +68,7 @@ import numpy as np
 from maggy_tpu import telemetry
 from maggy_tpu.exceptions import BadArgumentsError
 from maggy_tpu.models.generate import init_cache, prefill
+from maggy_tpu.serve.prefix import PrefixIndex
 from maggy_tpu.serve.request import Request
 from maggy_tpu.serve.slots import SlotManager, SlotOccupiedError
 
@@ -112,6 +125,8 @@ class Engine:
         mesh=None,
         telemetry_recorder=None,
         async_decode: Optional[bool] = None,
+        prefix_reuse: Optional[bool] = None,
+        prefix_min: Optional[int] = None,
     ):
         from maggy_tpu.models import Decoder
 
@@ -134,6 +149,22 @@ class Engine:
             ).lower() not in ("0", "false", "off")
         self.async_decode = async_decode
 
+        if prefix_reuse is None:
+            prefix_reuse = os.environ.get(
+                "MAGGY_TPU_SERVE_PREFIX", "1"
+            ).lower() not in ("0", "false", "off")
+        self.prefix_reuse = prefix_reuse
+        if prefix_min is None:
+            prefix_min = int(
+                os.environ.get("MAGGY_TPU_SERVE_PREFIX_MIN", MIN_PREFILL_BUCKET)
+            )
+        self.prefix_min = max(1, int(prefix_min))
+        self.prefix_index = PrefixIndex(min_len=self.prefix_min)
+        # prefix-reuse accounting (scheduler stats + SSTATS + telemetry)
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
+        self.prefill_calls = 0  # full (from-scratch) prefills
+
         B = num_slots
         dummy = jnp.zeros((B, 1), jnp.int32)
         self.cache = init_cache(self.decode_model, dummy, mesh=mesh)
@@ -153,10 +184,17 @@ class Engine:
         self._decode_traces = 0
         self._prefill_traces = 0
         self._admit_traces = 0
+        self._prefix_traces = 0
 
         self._decode_jit = jax.jit(self._decode_impl)
         self._admit_jit = jax.jit(self._admit_impl)
         self._prefill_jit = jax.jit(self._prefill_impl)
+        self._prefix_admit_jit = jax.jit(self._prefix_admit_impl)
+        # abstract single-row cache: the leaf-shape template the prefix-admit
+        # extraction uses to find each leaf's batch axis (mirrors _admit_impl)
+        self._row_abstract = jax.eval_shape(
+            lambda: init_cache(self.decode_model, jnp.zeros((1, 1), jnp.int32))
+        )
 
         self.steps = 0
         self.tokens_out = 0
@@ -208,6 +246,72 @@ class Engine:
             key_data, key_pair[None, :], (slot, jnp.int32(0))
         )
         return cache, key_data
+
+    def _prefix_admit_impl(
+        self,
+        params,
+        cache,
+        key_data,
+        src_slot,
+        dst_slot,
+        suffix_tokens,
+        start,
+        plen,
+        temp,
+        top_k,
+        key_pair,
+    ):
+        """Admit-from-prefix, one compiled program per suffix bucket: extract
+        batch row ``src_slot`` as a single-row cache whose write index is
+        pinned to ``start`` (the shared-prefix length — rows above it are the
+        source's own suffix/generated K/V, masked exactly like prefill pad
+        garbage), prefill ONLY the suffix through it (positions
+        ``start..start+Sb``), sample the first token from the last valid
+        suffix logit, and copy the row into ``dst_slot`` via the admit body.
+
+        ``start``/``plen`` are traced scalars, so reuse length never
+        retraces; only the suffix bucket shape does (same O(log) compile
+        ladder as full prefill)."""
+        self._prefix_traces += 1
+
+        def extract(path, batch_leaf, row_ab):
+            if "index" in jax.tree_util.keystr(path):
+                return jnp.full(row_ab.shape, start, row_ab.dtype)
+            axis = next(
+                (
+                    i
+                    for i, (a, r) in enumerate(
+                        zip(batch_leaf.shape, row_ab.shape)
+                    )
+                    if a != r
+                ),
+                0,
+            )
+            starts = [jnp.int32(0)] * batch_leaf.ndim
+            starts[axis] = src_slot
+            return jax.lax.dynamic_slice(batch_leaf, starts, row_ab.shape)
+
+        row_cache = jax.tree_util.tree_map_with_path(
+            extract, cache, self._row_abstract
+        )
+        positions = (start + jnp.arange(suffix_tokens.shape[1], dtype=jnp.int32))[
+            None, :
+        ]
+        logits, mutated = self.decode_model.apply(
+            {"params": params, "cache": row_cache},
+            suffix_tokens,
+            positions,
+            mutable=["cache"],
+        )
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], plen - start - 1, axis=0, keepdims=False
+        )  # [V] — the logit at overall position plen-1, same as full prefill
+        key = jax.random.fold_in(jax.random.wrap_key_data(key_pair), 0)
+        tok = _sample_one(last, temp, top_k, key)
+        cache, key_data = self._admit_impl(
+            cache, mutated["cache"], key_data, dst_slot, plen, key_pair
+        )
+        return cache, key_data, tok
 
     def _decode_impl(
         self,
@@ -289,40 +393,88 @@ class Engine:
         if not self.slots.free_slots():
             raise SlotOccupiedError("no free slot")
 
-        bucket = self._bucket(plen)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = request.prompt
         key_pair = jnp.asarray(_base_key_data(p.seed))
         slot = self.slots.free_slots()[0]
-        with self.telemetry.span("serve.prefill", bucket=bucket), self._ctx():
-            row_cache, tok = self._prefill_jit(
-                self.params,
-                jnp.asarray(padded),
-                jnp.int32(plen),
-                jnp.float32(p.temperature),
-                jnp.int32(p.top_k),
-                key_pair,
-            )
-            self.cache, self.key_data = self._admit_jit(
-                self.cache,
-                row_cache,
-                self.key_data,
-                jnp.int32(slot),
-                jnp.int32(plen),
-                key_pair,
-            )
+        reuse = self._match_prefix(request.prompt)
+        if reuse is not None:
+            src, shared = reuse
+            # the suffix bucket must still fit above the shared rows — cap it
+            # so the per-row cache write can never be position-clamped
+            bucket = min(self._bucket(plen - shared), self.max_seq_len - shared)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : plen - shared] = request.prompt[shared:]
+            with self.telemetry.span(
+                "serve.prefix_admit", bucket=bucket, shared=shared
+            ), self._ctx():
+                self.cache, self.key_data, tok = self._prefix_admit_jit(
+                    self.params,
+                    self.cache,
+                    self.key_data,
+                    jnp.int32(src),
+                    jnp.int32(slot),
+                    jnp.asarray(padded),
+                    jnp.int32(shared),
+                    jnp.int32(plen),
+                    jnp.float32(p.temperature),
+                    jnp.int32(p.top_k),
+                    key_pair,
+                )
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += shared
+            self.telemetry.count("serve.prefix_hits")
+            self.telemetry.count("serve.prefix_tokens_saved", shared)
+        else:
+            bucket = self._bucket(plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = request.prompt
+            with self.telemetry.span("serve.prefill", bucket=bucket), self._ctx():
+                row_cache, tok = self._prefill_jit(
+                    self.params,
+                    jnp.asarray(padded),
+                    jnp.int32(plen),
+                    jnp.float32(p.temperature),
+                    jnp.int32(p.top_k),
+                    key_pair,
+                )
+                self.cache, self.key_data = self._admit_jit(
+                    self.cache,
+                    row_cache,
+                    self.key_data,
+                    jnp.int32(slot),
+                    jnp.int32(plen),
+                    key_pair,
+                )
+            self.prefill_calls += 1
         # claim the slot only after every device op succeeded — a throwing
         # prefill/admit must not leak an occupied slot bound to a dead request
         first = int(tok)
         assert self.slots.admit(request, first) == slot
+        self.prefix_index.insert(slot, request.prompt)
         self.tokens_out += 1
         self._record_compile_gauges()
         return slot, first
+
+    def _match_prefix(self, prompt) -> Optional[Tuple[int, int]]:
+        """``(src_slot, shared_len)`` when a resident slot shares a usable
+        prefix with ``prompt``. The shared length is clamped to ``plen - 1``:
+        at least one suffix token must run through the model to produce the
+        logit that samples the request's first token."""
+        if not self.prefix_reuse:
+            return None
+        m = self.prefix_index.match(prompt)
+        if m is None:
+            return None
+        src, lcp = m
+        shared = min(lcp, len(prompt) - 1)
+        if shared < self.prefix_min:
+            return None
+        return src, shared
 
     def release(self, slot: int) -> Request:
         """Free a slot (EOS / max_new / cancel / deadline). Pure host-side:
         the decode step already zeroes inactive rows' cache index, and
         admission overwrites the full row."""
+        self.prefix_index.remove(slot)
         return self.slots.evict(slot)
 
     # ----------------------------------------------------------------- decode
@@ -456,4 +608,15 @@ class Engine:
             "decode": self._decode_traces,
             "prefill": self._prefill_traces,
             "admit": self._admit_traces,
+            "prefix_admit": self._prefix_traces,
+        }
+
+    @property
+    def prefix_stats(self) -> Dict[str, int]:
+        """Reuse accounting for SSTATS/telemetry: hits, tokens the copy
+        saved from prefill, and full prefills actually run."""
+        return {
+            "prefix_hits": self.prefix_hits,
+            "prefix_tokens_saved": self.prefix_tokens_saved,
+            "prefill_calls": self.prefill_calls,
         }

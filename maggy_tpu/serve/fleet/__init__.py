@@ -1,0 +1,70 @@
+"""Serving fleet: a router front-end over N engine replicas.
+
+The scale-out layer above the single continuous-batching engine
+(:mod:`maggy_tpu.serve`): each replica is a full engine+scheduler+RPC stack
+on a disjoint device lease, and the :class:`Router` is the one public
+address — same SUBMIT/POLL/CANCEL/SSTATS verbs, so clients and the monitor
+are fleet-oblivious. The router load-balances with SLO-aware admission
+control (shed or queue on projected TTFT), probes replica health into the
+resilience quarantine machinery, requeues a dead replica's in-flight
+requests to survivors, and respawns within a restart budget. See
+docs/fleet.md.
+
+    spec = ReplicaSpec(cfg, params, num_slots=4)
+    router = launch_fleet(spec, replicas=2, slo_ttft_ms=2000)
+    host, port = router.start(host="127.0.0.1")
+    # ... ServeClient((host, port), router.secret) as usual
+    router.stop()
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from maggy_tpu.serve.fleet.replica import (  # noqa: F401
+    Replica,
+    ReplicaSpec,
+    build_replicas,
+)
+from maggy_tpu.serve.fleet.router import (  # noqa: F401
+    Router,
+    RouterConfig,
+    projected_ttft_ms,
+)
+
+__all__ = [
+    "Replica",
+    "ReplicaSpec",
+    "Router",
+    "RouterConfig",
+    "build_replicas",
+    "launch_fleet",
+    "projected_ttft_ms",
+]
+
+
+def launch_fleet(
+    spec: ReplicaSpec,
+    replicas: int = 2,
+    config: Optional[RouterConfig] = None,
+    secret: Optional[str] = None,
+    name: str = "maggy-fleet",
+    host: str = "127.0.0.1",
+    telemetry_recorder=None,
+    **config_kwargs,
+) -> Router:
+    """Build a router over ``replicas`` fresh in-process replicas (device
+    leases carved like trial sub-slices). Call ``router.start()`` to serve;
+    extra kwargs go to :class:`RouterConfig` (``slo_ttft_ms=...`` etc.)."""
+    if config is None:
+        config = RouterConfig(**config_kwargs)
+    elif config_kwargs:
+        raise ValueError("pass either config= or RouterConfig kwargs, not both")
+    router = Router(
+        build_replicas(spec, replicas, secret or "", host=host),
+        config=config,
+        secret=secret,
+        name=name,
+        telemetry_recorder=telemetry_recorder,
+    )
+    return router

@@ -1,0 +1,199 @@
+"""One serving replica: an engine+scheduler+RPC-server triple the router
+owns.
+
+A replica is the fleet's unit of failure and of scale-out: each one runs the
+full single-engine serving stack (:mod:`maggy_tpu.serve`) on its own RPC
+port, leasing a disjoint accelerator device group exactly the way the
+experiment drivers lease trial sub-slices (``core.driver.base.device_groups``
+— one host, N concurrent workloads, zero chip contention). The router talks
+to it over the same :mod:`maggy_tpu.core.rpc` client any remote process
+would use, so an in-process replica (tests, single-host fleets) and a future
+cross-host replica present identical surfaces.
+
+Lifecycle: ``start()`` builds the engine and opens the port;
+``stop(drain=True)`` finishes resident requests before closing (the clean
+path the router's shutdown uses); ``kill()`` drops everything on the floor —
+the chaos path (``MAGGY_TPU_CHAOS="replica_kill:replica=N"``), standing in
+for a preempted or wedged host. ``respawn()`` rebuilds the whole stack after
+a kill, charged against the router's restart budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# replica lifecycle states (the quarantine overlay lives in the router's
+# QuarantineTracker, not here — a replica can be UP yet quarantined)
+STARTING = "starting"
+UP = "up"
+DEAD = "dead"
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """Everything needed to build (and rebuild) one replica's stack."""
+
+    cfg: Any
+    params: Any
+    num_slots: int = 4
+    mesh: Any = None
+    async_decode: Optional[bool] = None
+    prefix_reuse: Optional[bool] = None
+    # index -> telemetry recorder, so each replica's gauges land in its own
+    # worker JSONL (exported like any worker's)
+    telemetry_factory: Optional[Callable[[int], Any]] = None
+
+
+class Replica:
+    """In-process serving replica with a router-facing client."""
+
+    def __init__(
+        self,
+        index: int,
+        spec: ReplicaSpec,
+        secret: str,
+        host: str = "127.0.0.1",
+        devices: Optional[list] = None,
+    ):
+        self.index = index
+        self.spec = spec
+        self.secret = secret
+        self.host = host
+        # the device lease this replica serves on (observability; the mesh
+        # in the spec is what actually places computation)
+        self.devices = list(devices or [])
+        self.state = STARTING
+        self.restarts = 0
+        self.started_ts: Optional[float] = None
+        self.addr: Optional[Tuple[str, int]] = None
+        self.server = None  # ServeServer
+        self.client = None  # router-owned ServeClient
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self) -> Tuple[str, int]:
+        from maggy_tpu.serve import Engine, Scheduler, ServeClient, ServeServer
+
+        spec = self.spec
+        tel = (
+            spec.telemetry_factory(self.index)
+            if spec.telemetry_factory is not None
+            else None
+        )
+        engine = Engine(
+            spec.cfg,
+            spec.params,
+            num_slots=spec.num_slots,
+            mesh=spec.mesh,
+            telemetry_recorder=tel,
+            async_decode=spec.async_decode,
+            prefix_reuse=spec.prefix_reuse,
+        )
+        self.server = ServeServer(
+            Scheduler(engine), secret=self.secret, name=f"replica-{self.index}"
+        )
+        self.addr = self.server.start(host=self.host, port=0)
+        # the router's private client: plain single-shot calls — fleet-level
+        # failover lives in the router, not in this hop
+        self.client = ServeClient(self.addr, self.secret, failover=False)
+        self.state = UP
+        self.started_ts = time.time()
+        return self.addr
+
+    def alive(self) -> bool:
+        return self.state == UP
+
+    def kill(self) -> None:
+        """Chaos/hard death: close the port first (every in-flight and
+        future router call fails the way a preempted host's would), then
+        abandon the scheduler without draining."""
+        with self._lock:
+            if self.state == DEAD:
+                return
+            self.state = DEAD
+        if self.client is not None:
+            try:
+                self.client.close()
+            except Exception:  # noqa: BLE001 - already half-dead
+                pass
+        if self.server is not None:
+            self.server._rpc.stop()
+            self.server.scheduler.stop(timeout=2.0)
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Clean shutdown: finish resident work before closing sockets."""
+        with self._lock:
+            if self.state == DEAD:
+                return
+            self.state = DEAD
+        if self.server is not None:
+            if drain:
+                self.server.scheduler.drain(timeout=timeout)
+            if self.client is not None:
+                try:
+                    self.client.close()
+                except Exception:  # noqa: BLE001 - socket may already be gone
+                    pass
+            self.server.stop()
+
+    def respawn(self) -> Tuple[str, int]:
+        """Rebuild the full stack after a death (new engine, new port).
+        Counts one restart; the router enforces the budget."""
+        self.restarts += 1
+        self.state = STARTING
+        addr = self.start()
+        return addr
+
+    # ------------------------------------------------------------------ stats
+
+    def local_stats(self) -> Optional[Dict[str, Any]]:
+        """Freshest scheduler stats for an in-process replica — lock-guarded
+        host state only, no sockets, so the router's SSTATS handler may call
+        it on the event loop (the exact contract ServeServer's own SSTATS
+        handler follows). None when the replica is down (or remote, where
+        only the probe cache exists)."""
+        if self.state != UP or self.server is None:
+            return None
+        try:
+            return self.server.scheduler.stats()
+        except Exception:  # noqa: BLE001 - racing a concurrent kill()
+            return None
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "replica": self.index,
+            "state": self.state,
+            "addr": f"{self.addr[0]}:{self.addr[1]}" if self.addr else None,
+            "restarts": self.restarts,
+            "devices": [str(d) for d in self.devices],
+            "uptime_s": (
+                round(time.time() - self.started_ts, 1)
+                if self.started_ts and self.state == UP
+                else None
+            ),
+        }
+
+
+def build_replicas(
+    spec: ReplicaSpec, n: int, secret: str, host: str = "127.0.0.1"
+) -> list:
+    """N replicas over this host's accelerator leases: device groups are
+    carved exactly like trial leases (one group per replica, round-robin
+    when the host has fewer groups than replicas)."""
+    from maggy_tpu.core.driver.base import device_groups
+
+    groups = device_groups(devices_per_trial=1)
+    return [
+        Replica(
+            i,
+            spec,
+            secret,
+            host=host,
+            devices=groups[i % len(groups)] if groups else [],
+        )
+        for i in range(n)
+    ]

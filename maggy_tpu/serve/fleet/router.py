@@ -1,0 +1,732 @@
+"""SLO-aware request router over N serving replicas.
+
+The router is the fleet's only public address. It speaks the exact verb set
+a single :class:`~maggy_tpu.serve.server.ServeServer` speaks — SUBMIT /
+POLL / CANCEL / SSTATS / STATUS / LOG over :mod:`maggy_tpu.core.rpc` — so
+every existing client (:class:`~maggy_tpu.serve.ServeClient`, the monitor
+dashboard) points at a fleet unchanged. Behind the verbs:
+
+* **Routing.** SUBMIT mints a *router-owned* request id and places the
+  request on the least-loaded healthy replica (cached SSTATS: queue depth,
+  slot occupancy, TTFT percentiles). The id -> replica binding is sticky:
+  POLL and CANCEL always reach the replica that owns the request — and the
+  binding, not the replica, is durable: when a replica dies its requests are
+  re-bound, the id never changes.
+* **SLO-aware admission.** With ``slo_ttft_ms`` set, each SUBMIT is checked
+  against the best replica's *projected TTFT* (see ``projected_ttft_ms``).
+  Projection over budget either sheds the request with a 429-style ``BUSY``
+  reply (``admission="shed"``) or parks it in the router queue until
+  capacity frees (``admission="queue"``, the default). No healthy replica
+  at all always sheds.
+* **Health + requeue.** A pump thread probes replicas (SSTATS heartbeat)
+  and feeds failures into :class:`maggy_tpu.resilience.QuarantineTracker` —
+  the same policy object that benches flaky HPO workers. A quarantined or
+  dead replica's in-flight requests are requeued *ahead of* fresh arrivals
+  (the retry-queue-outranks-suggestions rule the HPO driver uses) and
+  resubmitted to survivors; until redispatch, POLL reports
+  ``state="requeued"``. Dead replicas are respawned within
+  ``max_restarts``. The chaos seam
+  (``MAGGY_TPU_CHAOS="replica_kill:replica=N"``) kills a busy replica
+  deterministically so all of this is testable on one CPU.
+
+Handlers run on the RPC event loop and only touch lock-guarded host state;
+every downstream socket round-trip (dispatch, poll fan-out, probes) belongs
+to the pump thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import secrets as secrets_mod
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from maggy_tpu import telemetry
+from maggy_tpu.core import rpc
+from maggy_tpu.exceptions import RpcError, RpcRejectedError
+from maggy_tpu.resilience import chaos as chaos_mod
+from maggy_tpu.resilience.policy import QuarantineTracker
+from maggy_tpu.serve.fleet.replica import DEAD, UP, Replica
+
+# router-side request states (downstream states pass through verbatim)
+PENDING = "pending"  # accepted, not yet on a replica
+ROUTED = "routed"  # live on a replica
+REQUEUED = "requeued"  # owner died; waiting for redispatch
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """Admission and health knobs (docs/fleet.md "Admission control")."""
+
+    slo_ttft_ms: Optional[float] = None  # None: admit everything
+    admission: str = "queue"  # "queue" | "shed" when projection > SLO
+    max_queue: int = 1024  # router-side pending bound
+    probe_interval_s: float = 0.25  # SSTATS heartbeat cadence
+    pump_interval_s: float = 0.005  # dispatch/poll loop cadence
+    quarantine_threshold: int = 2  # consecutive probe failures
+    quarantine_cooldown_s: float = 30.0
+    max_restarts: int = 1  # fleet-wide respawn budget
+    default_service_ms: float = 100.0  # TTFT prior before any p50 exists
+
+    def validate(self) -> None:
+        if self.admission not in ("queue", "shed"):
+            raise ValueError(
+                f"admission must be 'queue' or 'shed', got {self.admission!r}"
+            )
+
+
+def projected_ttft_ms(stats: Dict[str, Any], prior_ms: float) -> float:
+    """Projected time-to-first-token on a replica with these SSTATS.
+
+    The model is deliberately simple and stated so operators can reason
+    about sheds: a free slot with an empty queue costs one prefill
+    (~observed TTFT p50, or the prior before one exists); otherwise the
+    request waits behind ``queue_depth`` others served ``num_slots`` at a
+    time, each wave costing roughly one observed TTFT."""
+    p50 = stats.get("ttft_ms_p50") or prior_ms
+    free = stats.get("num_slots", 1) - stats.get("active_slots", 0)
+    depth = stats.get("queue_depth", 0)
+    if free > 0 and depth == 0:
+        return float(p50)
+    waves = (depth + 1) / max(1, stats.get("num_slots", 1))
+    return float(p50) * (1.0 + waves)
+
+
+@dataclasses.dataclass
+class RouteEntry:
+    """One router-owned request and its sticky downstream binding."""
+
+    rid: str
+    payload: Dict[str, Any]  # submit kwargs, replayable on requeue
+    state: str = PENDING
+    replica: Optional[int] = None
+    remote_id: Optional[str] = None
+    snapshot: Optional[Dict[str, Any]] = None  # last downstream POLL
+    final: Optional[Dict[str, Any]] = None  # router-local terminal snapshot
+    submitted_ts: float = dataclasses.field(default_factory=time.time)
+    deadline_ts: Optional[float] = None
+    resubmits: int = 0
+    cancel_requested: bool = False
+    cancel_sent: bool = False
+    counted_done: bool = False
+
+    def done(self) -> bool:
+        if self.final is not None:
+            return True
+        return bool(self.snapshot and self.snapshot.get("done"))
+
+    def wire(self) -> Dict[str, Any]:
+        """POLL reply: downstream snapshot under the ROUTER id."""
+        if self.final is not None:
+            body = dict(self.final)
+        elif self.state == ROUTED and self.snapshot is not None:
+            body = dict(self.snapshot)
+        else:
+            body = {
+                "state": "queued" if self.state == PENDING else REQUEUED,
+                "tokens": [],
+                "n_tokens": 0,
+                "prompt_len": len(self.payload.get("prompt", [])),
+                "error": None,
+                "ttft_ms": None,
+                "done": False,
+            }
+        body["id"] = self.rid
+        body["replica"] = self.replica
+        body["resubmits"] = self.resubmits
+        return body
+
+
+class Router:
+    """Fleet front-end: one RPC server, N replicas, one pump thread."""
+
+    def __init__(
+        self,
+        replicas: List[Replica],
+        config: Optional[RouterConfig] = None,
+        secret: Optional[str] = None,
+        name: str = "maggy-fleet",
+        telemetry_recorder=None,
+    ):
+        self.config = config or RouterConfig()
+        self.config.validate()
+        self.replicas = list(replicas)
+        self.name = name
+        self.telemetry = telemetry_recorder or telemetry.get()
+        self._rpc = rpc.Server(num_executors=0, secret=secret)
+        self._rpc.telemetry = self.telemetry
+        self.quarantine = QuarantineTracker(
+            threshold=self.config.quarantine_threshold,
+            cooldown=self.config.quarantine_cooldown_s,
+        )
+        self._lock = threading.RLock()
+        self._entries: Dict[str, RouteEntry] = {}
+        self._pending: deque = deque()  # rids; requeues go left, fresh right
+        self._stats_cache: Dict[int, Dict[str, Any]] = {}
+        self._down_handled: set = set()  # replica idx whose death was requeued
+        self._restarts_used = 0
+        self._rr = 0  # round-robin tie-break cursor
+        self.counters: Dict[str, int] = {
+            "routed": 0,
+            "requeued": 0,
+            "shed": 0,
+            "completed": 0,
+            "failed": 0,
+            "expired": 0,
+            "cancelled": 0,
+            "respawned": 0,
+        }
+        self._log: deque = deque(maxlen=500)
+        self._closing = False
+        self._stop = threading.Event()
+        self._pump: Optional[threading.Thread] = None
+        self._started_ts = time.time()
+        for verb, handler in (
+            ("SUBMIT", self._on_submit),
+            ("POLL", self._on_poll),
+            ("CANCEL", self._on_cancel),
+            ("SSTATS", self._on_stats),
+            ("STATUS", self._on_status),
+            ("LOG", self._on_log),
+        ):
+            self._rpc.register_callback(verb, handler)
+
+    @property
+    def secret(self) -> str:
+        return self._rpc.secret
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self, host: str = "0.0.0.0", port: int = 0) -> Tuple[str, int]:
+        for replica in self.replicas:
+            if replica.state != UP:
+                replica.secret = self.secret
+                replica.start()
+                self.log(
+                    f"replica {replica.index} up at "
+                    f"{replica.addr[0]}:{replica.addr[1]}"
+                )
+        addr = self._rpc.start(host=host, port=port)
+        self._stop.clear()
+        self._pump = threading.Thread(
+            target=self._pump_loop, name="maggy-fleet-pump", daemon=True
+        )
+        self._pump.start()
+        self.log(
+            f"router on {addr[0]}:{addr[1]} ({len(self.replicas)} replicas, "
+            f"slo_ttft_ms={self.config.slo_ttft_ms}, "
+            f"admission={self.config.admission})"
+        )
+        return addr
+
+    def stop(self, drain_timeout: float = 30.0) -> None:
+        """Clean shutdown: stop admitting, let replicas finish resident
+        work, then close sockets — in that order, so no accepted request is
+        dropped by the shutdown itself."""
+        with self._lock:
+            self._closing = True
+        deadline = time.time() + drain_timeout
+        while time.time() < deadline:
+            with self._lock:
+                live = any(
+                    not e.done()
+                    for e in self._entries.values()
+                )
+            if not live:
+                break
+            time.sleep(0.02)
+        self._stop.set()
+        if self._pump is not None:
+            self._pump.join(timeout=5.0)
+            self._pump = None
+        for replica in self.replicas:
+            # replica drain is second-layer insurance (their own queues)
+            replica.stop(drain=replica.state == UP, timeout=drain_timeout)
+        self._rpc.stop()
+
+    def log(self, line: str) -> None:
+        self._log.append(f"[{time.strftime('%H:%M:%S')}] {line}")
+
+    # ------------------------------------------------------------ projections
+
+    def _healthy(self) -> List[Replica]:
+        now = time.time()
+        return [
+            r
+            for r in self.replicas
+            if r.state == UP and not self.quarantine.is_quarantined(r.index, now)
+        ]
+
+    def _pick_replica(self, healthy: List[Replica]) -> Tuple[Replica, float]:
+        """Least projected TTFT; round-robin cursor breaks ties so equal
+        replicas share load instead of all traffic piling on index 0."""
+        cfg = self.config
+        scored = []
+        for offset in range(len(healthy)):
+            r = healthy[(self._rr + offset) % len(healthy)]
+            stats = self._stats_cache.get(r.index, {})
+            scored.append((projected_ttft_ms(stats, cfg.default_service_ms), r))
+        proj, best = min(scored, key=lambda pr: pr[0])
+        self._rr += 1
+        return best, proj
+
+    # ----------------------------------------------------------------- verbs
+    # (event-loop thread: lock-guarded host state only, no sockets)
+
+    def _busy(self, why: str, projected: Optional[float] = None) -> Dict[str, Any]:
+        with self._lock:
+            self.counters["shed"] += 1
+        self.telemetry.count("fleet.shed")
+        reply: Dict[str, Any] = {"type": "BUSY", "error": why}
+        if projected is not None:
+            reply["projected_ttft_ms"] = round(projected, 1)
+        reply["retry_after_s"] = 0.25
+        return reply
+
+    def _on_submit(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = msg.get("prompt")
+        if not isinstance(prompt, list) or not all(
+            isinstance(t, int) for t in prompt
+        ):
+            raise ValueError("prompt must be a list of token ids")
+        with self._lock:
+            if self._closing:
+                return self._busy("router shutting down")
+            healthy = self._healthy()
+            if not healthy:
+                return self._busy("no healthy replica")
+            pending_depth = len(self._pending)
+            if pending_depth >= self.config.max_queue:
+                return self._busy(
+                    f"router queue full ({self.config.max_queue})"
+                )
+            cfg = self.config
+            if cfg.slo_ttft_ms is not None:
+                # admission control: project TTFT on the best replica, plus
+                # one wave per router-queued request ahead of this one
+                stats_best = min(
+                    (
+                        projected_ttft_ms(
+                            self._stats_cache.get(r.index, {}),
+                            cfg.default_service_ms,
+                        )
+                        for r in healthy
+                    ),
+                )
+                backlog_ms = (
+                    pending_depth
+                    * cfg.default_service_ms
+                    / max(1, sum(r.spec.num_slots for r in healthy))
+                )
+                projected = stats_best + backlog_ms
+                if projected > cfg.slo_ttft_ms and cfg.admission == "shed":
+                    return self._busy(
+                        f"projected TTFT {projected:.0f}ms exceeds SLO "
+                        f"{cfg.slo_ttft_ms:.0f}ms",
+                        projected,
+                    )
+            rid = secrets_mod.token_hex(8)
+            payload = {
+                "prompt": [int(t) for t in prompt],
+                "temperature": float(msg.get("temperature", 0.0)),
+                "top_k": int(msg.get("top_k", 0)),
+                "max_new": int(msg.get("max_new", 16)),
+                "eos_id": int(msg.get("eos_id", -1)),
+                "seed": int(msg.get("seed", 0)),
+            }
+            entry = RouteEntry(rid=rid, payload=payload)
+            deadline_s = msg.get("deadline_s")
+            if deadline_s:
+                entry.deadline_ts = time.time() + float(deadline_s)
+                entry.payload["deadline_s"] = float(deadline_s)
+            self._entries[rid] = entry
+            self._pending.append(rid)
+        return {"type": "SUBMIT", "id": rid}
+
+    def _on_poll(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            entry = self._entries.get(str(msg.get("id")))
+            if entry is None:
+                raise ValueError(f"unknown request {msg.get('id')!r}")
+            return {"type": "POLL", **entry.wire()}
+
+    def _on_cancel(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            entry = self._entries.get(str(msg.get("id")))
+            if entry is None or entry.done():
+                return {"type": "CANCEL", "cancelled": False}
+            entry.cancel_requested = True
+            if entry.state in (PENDING, REQUEUED):
+                self._finish_local(entry, "cancelled")
+        return {"type": "CANCEL", "cancelled": True}
+
+    def _finish_local(self, entry: RouteEntry, state: str, error=None) -> None:
+        """Terminal without a downstream snapshot (lock held)."""
+        entry.final = {
+            "state": state,
+            "tokens": [],
+            "n_tokens": 0,
+            "prompt_len": len(entry.payload.get("prompt", [])),
+            "error": error,
+            "ttft_ms": None,
+            "done": True,
+        }
+        try:
+            self._pending.remove(entry.rid)
+        except ValueError:
+            pass
+        key = {"cancelled": "cancelled", "expired": "expired", "failed": "failed"}[
+            state
+        ]
+        self.counters[key] += 1
+        entry.counted_done = True
+
+    def _fleet_stats(self) -> Dict[str, Any]:
+        """Aggregate + per-replica table (lock held)."""
+        now = time.time()
+        table = []
+        agg = {
+            "queue_depth": len(self._pending),
+            "active_slots": 0,
+            "num_slots": 0,
+            "tokens_out": 0,
+            "requests_done": 0,
+            "requests_failed": 0,
+            "prefix_hits": 0,
+            "prefix_tokens_saved": 0,
+            "prefill_calls": 0,
+        }
+        p50s, p95s = [], []
+        for r in self.replicas:
+            # in-process replicas answer fresh (lock-only, no sockets);
+            # remote/dead ones fall back to the probe cache
+            local = getattr(r, "local_stats", lambda: None)()
+            stats = local or self._stats_cache.get(r.index, {})
+            quarantined = self.quarantine.is_quarantined(r.index, now)
+            row = {
+                **r.describe(),
+                "quarantined": quarantined,
+                "queue_depth": stats.get("queue_depth", 0),
+                "active_slots": stats.get("active_slots", 0),
+                "num_slots": stats.get("num_slots", r.spec.num_slots),
+                "requests_done": stats.get("requests_done", 0),
+                "tokens_per_sec": stats.get("tokens_per_sec", 0.0),
+                "prefix_hits": stats.get("prefix_hits", 0),
+                "prefix_tokens_saved": stats.get("prefix_tokens_saved", 0),
+                "ttft_ms_p50": stats.get("ttft_ms_p50"),
+            }
+            if quarantined:
+                row["state"] = "quarantined"
+            table.append(row)
+            if r.state == UP and not quarantined:
+                agg["queue_depth"] += stats.get("queue_depth", 0)
+            for k in (
+                "active_slots",
+                "num_slots",
+                "tokens_out",
+                "requests_done",
+                "requests_failed",
+                "prefix_hits",
+                "prefix_tokens_saved",
+                "prefill_calls",
+            ):
+                agg[k] += stats.get(k, 0)
+            if stats.get("ttft_ms_p50") is not None:
+                p50s.append(stats["ttft_ms_p50"])
+            if stats.get("ttft_ms_p95") is not None:
+                p95s.append(stats["ttft_ms_p95"])
+        # conservative fleet percentiles: the slowest replica bounds the SLO
+        agg["ttft_ms_p50"] = max(p50s) if p50s else None
+        agg["ttft_ms_p95"] = max(p95s) if p95s else None
+        return {
+            **agg,
+            "replicas": table,
+            "routing": dict(self.counters),
+            "in_flight": sum(
+                1 for e in self._entries.values() if not e.done()
+            ),
+            "uptime_s": round(time.time() - self._started_ts, 3),
+        }
+
+    def _on_stats(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            return {"type": "SSTATS", "fleet": True, **self._fleet_stats()}
+
+    def _on_status(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            stats = self._fleet_stats()
+        status: Dict[str, Any] = {
+            "type": "STATUS",
+            "name": self.name,
+            "kind": "serve-fleet",
+            "state": "closing" if self._closing else "serving",
+            "app_id": self.name,
+            "run_id": 0,
+            "elapsed_s": time.time() - self._started_ts,
+            "serve": stats,
+            "fleet": {
+                "replicas": stats["replicas"],
+                "routing": stats["routing"],
+            },
+        }
+        tel = self.telemetry
+        if getattr(tel, "active", False):
+            snap = tel.snapshot()
+            if snap:
+                status["telemetry"] = {"router": snap}
+        return status
+
+    def _on_log(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            lines = list(self._log)
+            self._log.clear()
+            stats = self._fleet_stats()
+        progress = (
+            f"replicas {sum(1 for r in stats['replicas'] if r['state'] == UP)}"
+            f"/{len(self.replicas)}  queue {stats['queue_depth']}  "
+            f"done {stats['requests_done']}  "
+            f"requeued {stats['routing']['requeued']}"
+        )
+        return {"type": "LOG", "logs": lines, "progress": progress}
+
+    # ------------------------------------------------------------------ pump
+    # (single background thread: all downstream sockets live here)
+
+    # terminal entries stay pollable this long (mirrors scheduler retention)
+    RETENTION_S = 300.0
+
+    def _retire_old(self, now: float) -> None:
+        with self._lock:
+            dead = [
+                rid
+                for rid, e in self._entries.items()
+                if e.done() and now - e.submitted_ts > self.RETENTION_S
+            ]
+            for rid in dead:
+                del self._entries[rid]
+
+    def _pump_loop(self) -> None:
+        last_probe = 0.0
+        while not self._stop.is_set():
+            now = time.time()
+            try:
+                if now - last_probe >= self.config.probe_interval_s:
+                    self._probe_replicas()
+                    self._retire_old(now)
+                    last_probe = now
+                self._chaos_tick()
+                self._sweep_down_replicas()
+                self._dispatch_pending(time.time())
+                self._poll_routed()
+            except Exception as e:  # noqa: BLE001 - pump must survive anything
+                self.log(f"pump error: {type(e).__name__}: {e}")
+            self._stop.wait(self.config.pump_interval_s)
+
+    def _probe_replicas(self) -> None:
+        for replica in self.replicas:
+            if replica.state != UP:
+                self._note_failure(replica, "down")
+                continue
+            try:
+                stats = replica.client.stats()
+            except (RpcError, OSError) as e:
+                self._note_failure(replica, f"{type(e).__name__}: {e}")
+                continue
+            with self._lock:
+                self._stats_cache[replica.index] = stats
+            self.quarantine.record_success(replica.index)
+            with self._lock:
+                self._down_handled.discard(replica.index)
+        self.telemetry.gauge(
+            "fleet.healthy_replicas", float(len(self._healthy()))
+        )
+
+    def _note_failure(self, replica: Replica, why: str) -> None:
+        tripped = self.quarantine.record_failure(replica.index)
+        if tripped:
+            self.log(f"replica {replica.index} quarantined ({why})")
+            self.telemetry.count("fleet.quarantined")
+        # a closed port IS death — don't wait out the probe threshold
+        if replica.state == DEAD or self.quarantine.is_quarantined(replica.index):
+            self._handle_replica_down(replica)
+
+    def _handle_replica_down(self, replica: Replica) -> None:
+        """Requeue the dead/quarantined replica's in-flight requests ahead
+        of fresh arrivals, then respawn it if budget remains."""
+        with self._lock:
+            if replica.index in self._down_handled:
+                return
+            self._down_handled.add(replica.index)
+            moved = 0
+            for entry in self._entries.values():
+                if entry.replica == replica.index and not entry.done():
+                    entry.state = REQUEUED
+                    entry.replica = None
+                    entry.remote_id = None
+                    entry.snapshot = None
+                    entry.resubmits += 1
+                    self._pending.appendleft(entry.rid)
+                    moved += 1
+            self.counters["requeued"] += moved
+            self._stats_cache.pop(replica.index, None)
+            respawn = (
+                replica.state == DEAD
+                and self._restarts_used < self.config.max_restarts
+            )
+            if respawn:
+                self._restarts_used += 1
+        if moved:
+            self.log(
+                f"replica {replica.index} down: requeued {moved} request(s) "
+                "to survivors"
+            )
+            self.telemetry.count("fleet.requeued", moved)
+        if respawn:
+            try:
+                addr = replica.respawn()
+            except Exception as e:  # noqa: BLE001 - respawn is best-effort within budget
+                self.log(
+                    f"replica {replica.index} respawn failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+                return
+            self.quarantine.record_success(replica.index)
+            with self._lock:
+                self._down_handled.discard(replica.index)
+                self.counters["respawned"] += 1
+            self.log(
+                f"replica {replica.index} respawned at {addr[0]}:{addr[1]} "
+                f"({self.config.max_restarts - self._restarts_used} restarts left)"
+            )
+
+    def _sweep_down_replicas(self) -> None:
+        """Catch deaths between probes (chaos kill closes the port at once)."""
+        for replica in self.replicas:
+            if replica.state == DEAD:
+                with self._lock:
+                    handled = replica.index in self._down_handled
+                if not handled:
+                    self._handle_replica_down(replica)
+
+    def _chaos_tick(self) -> None:
+        """`replica_kill:replica=N` fires once the target is actually
+        decoding (mid-stream by construction, so requeue is exercised)."""
+        ch = chaos_mod.get()
+        if ch is None:
+            return
+        for replica in self.replicas:
+            if replica.state != UP:
+                continue
+            with self._lock:
+                busy = any(
+                    e.replica == replica.index and not e.done()
+                    and e.snapshot is not None
+                    and e.snapshot.get("n_tokens", 0) > 0
+                    for e in self._entries.values()
+                )
+            if busy and ch.replica_kill(replica.index):
+                self.log(f"chaos: killing replica {replica.index}")
+                replica.kill()
+
+    def _dispatch_pending(self, now: float) -> None:
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                rid = self._pending[0]
+                entry = self._entries.get(rid)
+                if entry is None or entry.done():
+                    self._pending.popleft()
+                    continue
+                if entry.deadline_ts is not None and now > entry.deadline_ts:
+                    self._pending.popleft()
+                    self._finish_local(
+                        entry, "expired", "deadline exceeded in router queue"
+                    )
+                    continue
+                healthy = self._healthy()
+                if not healthy:
+                    return
+                best, proj = self._pick_replica(healthy)
+                cfg = self.config
+                if (
+                    cfg.slo_ttft_ms is not None
+                    and cfg.admission == "queue"
+                    and entry.state == PENDING
+                    and proj > cfg.slo_ttft_ms
+                ):
+                    return  # hold fresh work until capacity projects in-SLO
+                self._pending.popleft()
+            try:
+                remote_id = best.client.submit(**entry.payload)
+            except RpcRejectedError as e:
+                with self._lock:
+                    self._finish_local(entry, "failed", str(e))
+                continue
+            except (RpcError, OSError) as e:
+                with self._lock:
+                    entry.state = REQUEUED
+                    self._pending.appendleft(rid)
+                self._note_failure(best, f"submit: {type(e).__name__}")
+                return
+            with self._lock:
+                entry.state = ROUTED
+                entry.replica = best.index
+                entry.remote_id = remote_id
+                self.counters["routed"] += 1
+                # book the new load locally so picks between probes see it
+                cached = self._stats_cache.setdefault(best.index, {})
+                cached["queue_depth"] = cached.get("queue_depth", 0) + 1
+            self.telemetry.count("fleet.routed")
+
+    def _poll_routed(self) -> None:
+        with self._lock:
+            live = [
+                (e.rid, e.replica, e.remote_id, e.cancel_requested, e.cancel_sent)
+                for e in self._entries.values()
+                if e.state == ROUTED and not e.done()
+            ]
+        for rid, idx, remote_id, want_cancel, cancel_sent in live:
+            replica = self.replicas[idx]
+            if replica.state != UP:
+                continue  # the down-sweep requeues; don't poke a closed port
+            try:
+                if want_cancel and not cancel_sent:
+                    replica.client.cancel(remote_id)
+                    with self._lock:
+                        entry = self._entries.get(rid)
+                        if entry is not None:
+                            entry.cancel_sent = True
+                snap = replica.client.poll(remote_id)
+            except RpcRejectedError:
+                # replica forgot the id (restart/retention): replay it
+                with self._lock:
+                    entry = self._entries.get(rid)
+                    if entry is not None and not entry.done():
+                        entry.state = REQUEUED
+                        entry.replica = None
+                        entry.remote_id = None
+                        entry.snapshot = None
+                        entry.resubmits += 1
+                        self.counters["requeued"] += 1
+                        self._pending.appendleft(rid)
+                continue
+            except (RpcError, OSError) as e:
+                self._note_failure(replica, f"poll: {type(e).__name__}")
+                return
+            with self._lock:
+                entry = self._entries.get(rid)
+                if entry is None or entry.state != ROUTED:
+                    continue
+                entry.snapshot = snap
+                if snap.get("done") and not entry.counted_done:
+                    entry.counted_done = True
+                    key = {
+                        "done": "completed",
+                        "cancelled": "cancelled",
+                        "expired": "expired",
+                        "failed": "failed",
+                    }.get(snap.get("state"), "completed")
+                    self.counters[key] += 1

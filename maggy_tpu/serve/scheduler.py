@@ -119,22 +119,35 @@ class Scheduler:
             return True
 
     def stats(self) -> Dict[str, Any]:
+        """One consistent snapshot, built entirely under the scheduler lock.
+
+        The router polls SSTATS concurrently with the engine loop; every
+        mutable structure read here (queue, counters, TTFT deque) is copied
+        while the lock is held so a mid-iteration mutation can never tear the
+        snapshot (dict-changed-size during iteration) or mix counters from
+        two different instants. Engine counters are plain ints the scheduler
+        thread owns — single reads are atomic under the GIL."""
         with self._lock:
             ttft = sorted(self._ttft_ms)
-            pct = lambda q: ttft[min(len(ttft) - 1, int(q * len(ttft)))] if ttft else None  # noqa: E731
-            return {
-                "queue_depth": len(self._queue),
-                "active_slots": self.engine.slots.active_count,
-                "num_slots": self.engine.slots.num_slots,
-                "tokens_out": self.engine.tokens_out,
+            counters = dict(self.counters)
+            queue_depth = len(self._queue)
+            engine = self.engine
+            snap = {
+                "queue_depth": queue_depth,
+                "active_slots": engine.slots.active_count,
+                "num_slots": engine.slots.num_slots,
+                "tokens_out": engine.tokens_out,
                 "tokens_per_sec": round(self._tok_rate_ema, 2),
-                "steps": self.engine.steps,
+                "steps": engine.steps,
                 "uptime_s": round(time.time() - self._started_ts, 3),
-                "ttft_ms_p50": pct(0.50),
-                "ttft_ms_p95": pct(0.95),
-                "compile_counts": self.engine.compile_counts,
-                **{f"requests_{k}": v for k, v in self.counters.items()},
+                "compile_counts": engine.compile_counts,
+                **engine.prefix_stats,
             }
+        pct = lambda q: ttft[min(len(ttft) - 1, int(q * len(ttft)))] if ttft else None  # noqa: E731
+        snap["ttft_ms_p50"] = pct(0.50)
+        snap["ttft_ms_p95"] = pct(0.95)
+        snap.update({f"requests_{k}": v for k, v in counters.items()})
+        return snap
 
     # -------------------------------------------------------------- lifecycle
 

@@ -1,0 +1,248 @@
+"""Prefix-KV reuse (maggy_tpu/serve/prefix.py + engine admit-from-prefix).
+
+The ISSUE 6 acceptance criteria: a request sharing a resident prompt prefix
+skips prefill for the shared tokens (counter-verified), outputs are
+byte-identical to no-reuse — greedy AND sampled — and the decode/admit
+programs still compile once across request churn.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.models.generate import generate_cached
+from maggy_tpu.parallel.sharding import unbox
+from maggy_tpu.serve import Engine, PrefixIndex, Request, SamplingParams
+
+CFG = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+SYS = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]  # 12-token shared "system prompt"
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Decoder(CFG)
+    return unbox(
+        model.init(jax.random.key(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+
+
+def reference(params, prompt, max_new):
+    decode_model = Decoder(dataclasses.replace(CFG, decode=True))
+    buf = np.zeros((1, len(prompt) + max_new), np.int32)
+    buf[0, : len(prompt)] = prompt
+    out = generate_cached(
+        decode_model, params, jnp.asarray(buf), jnp.asarray([len(prompt)])
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+def run_engine(params, requests, max_new, prefix_reuse, telemetry_recorder=None):
+    """Admit all requests (slots permitting), decode to completion; returns
+    ({request_index: tokens}, engine)."""
+    engine = Engine(
+        CFG,
+        params,
+        num_slots=4,
+        prefix_reuse=prefix_reuse,
+        telemetry_recorder=telemetry_recorder,
+    )
+    streams = {}
+    slot_of = {}
+    for i, (prompt, sp) in enumerate(requests):
+        slot, first = engine.admit(Request(prompt=prompt, params=sp))
+        streams[i] = [first]
+        slot_of[slot] = i
+    while any(
+        len(streams[slot_of[s]]) < max_new for s in engine.slots.active_slots()
+    ):
+        out = engine.step()
+        for s, t in out.tokens.items():
+            i = slot_of[s]
+            if len(streams[i]) < max_new:
+                streams[i].append(t)
+    for s in list(engine.slots.active_slots()):
+        engine.release(s)
+    engine.flush()
+    return streams, engine
+
+
+# ------------------------------------------------------------- index units
+
+
+def test_prefix_index_match_and_remove():
+    idx = PrefixIndex(min_len=8)
+    idx.insert(0, SYS + [11, 12, 13])
+    # exact-LCP extension past the bucket that found it
+    slot, lcp = idx.match(SYS + [11, 12, 99])
+    assert slot == 0 and lcp == 14  # 12 shared + [11, 12]
+    # shorter than min_len: no match
+    assert idx.match(SYS[:6] + [99, 98, 97]) is None
+    # unrelated prompt: no match
+    assert idx.match(list(range(40, 60))) is None
+    # newest insertion wins the shared bucket
+    idx.insert(1, SYS + [21])
+    slot, lcp = idx.match(SYS + [21, 5])
+    assert slot == 1 and lcp == 13
+    # removal un-indexes
+    idx.remove(1)
+    idx.remove(0)
+    assert idx.match(SYS + [11]) is None
+    assert idx.resident() == {}
+
+
+def test_prefix_index_prefers_longest_bucket():
+    idx = PrefixIndex(min_len=8)
+    idx.insert(0, SYS[:8] + [70, 71, 72, 73, 74, 75, 76, 77])
+    idx.insert(1, SYS[:8] + [70, 71, 72, 73, 74, 75, 76, 99])
+    # a probe sharing 16 tokens with slot 0 must find slot 0 via the
+    # 16-bucket even though slot 1 owns the 8-bucket (newest insertion)
+    slot, lcp = idx.match(SYS[:8] + [70, 71, 72, 73, 74, 75, 76, 77, 1, 2])
+    assert slot == 0 and lcp == 16
+
+
+# ------------------------------------------------------------- byte parity
+
+
+def test_prefix_reuse_greedy_parity(params):
+    """Greedy outputs identical with reuse on/off; prefill runs only for
+    the suffix on the hit."""
+    max_new = 6
+    requests = [
+        (SYS + [11, 12, 13], SamplingParams(max_new=max_new)),
+        (SYS + [21, 22], SamplingParams(max_new=max_new)),
+        (SYS + [31], SamplingParams(max_new=max_new)),
+    ]
+    on, eng_on = run_engine(params, requests, max_new, prefix_reuse=True)
+    off, eng_off = run_engine(params, requests, max_new, prefix_reuse=False)
+    assert on == off, "prefix reuse changed tokens"
+    for i, (prompt, _) in enumerate(requests):
+        assert on[i] == reference(params, prompt, max_new)
+    # counter-verified: request 0 full-prefilled; 1 and 2 reused 12 tokens
+    assert eng_on.prefill_calls == 1
+    assert eng_on.prefix_hits == 2
+    assert eng_on.prefix_tokens_saved == 2 * len(SYS)
+    assert eng_off.prefill_calls == 3
+    assert eng_off.prefix_hits == 0
+    # decode still compiled exactly once on both engines
+    assert eng_on.compile_counts["decode"] == 1
+    assert eng_off.compile_counts["decode"] == 1
+
+
+def test_prefix_reuse_sampled_parity(params):
+    """Sampled outputs (temperature + top_k, per-request seeds) are also
+    byte-identical: the reused rows are exact and the PRNG chain depends
+    only on (params, prompt, seed)."""
+    max_new = 6
+    requests = [
+        (SYS + [11, 12], SamplingParams(max_new=max_new, temperature=0.9,
+                                        top_k=12, seed=5)),
+        (SYS + [41, 42, 43], SamplingParams(max_new=max_new, temperature=0.7,
+                                            top_k=8, seed=9)),
+    ]
+    on, eng_on = run_engine(params, requests, max_new, prefix_reuse=True)
+    off, eng_off = run_engine(params, requests, max_new, prefix_reuse=False)
+    assert on == off
+    assert eng_on.prefix_hits == 1 and eng_off.prefix_hits == 0
+
+
+def test_identical_prompt_reuses_all_but_last_token(params):
+    """A fully identical resident prompt still prefills >= 1 suffix token
+    (the logit that samples the first output) and reuses the rest."""
+    max_new = 4
+    prompt = SYS + [11, 12]
+    requests = [
+        (prompt, SamplingParams(max_new=max_new)),
+        (list(prompt), SamplingParams(max_new=max_new)),
+    ]
+    on, eng = run_engine(params, requests, max_new, prefix_reuse=True)
+    assert on[0] == on[1] == reference(params, prompt, max_new)
+    assert eng.prefix_hits == 1
+    assert eng.prefix_tokens_saved == len(prompt) - 1
+
+
+def test_prefix_churn_compile_once(params):
+    """Release/re-admit churn against a long-lived resident: every churned
+    request admits from the anchor's prefix, one decode compile for the
+    whole run, and released slots leave the index (no stale-slot reuse)."""
+    max_new = 3
+    engine = Engine(CFG, params, num_slots=2, prefix_reuse=True)
+    # the anchor stays resident across every wave (system-prompt stand-in)
+    anchor_slot, _ = engine.admit(
+        Request(prompt=SYS + [99], params=SamplingParams(max_new=50))
+    )
+    outputs = {}
+    for wave in range(3):
+        prompt = SYS + [40 + wave]
+        slot, first = engine.admit(
+            Request(prompt=prompt, params=SamplingParams(max_new=max_new))
+        )
+        stream = [first]
+        while len(stream) < max_new:
+            out = engine.step()
+            if slot in out.tokens:
+                stream.append(out.tokens[slot])
+        engine.release(slot)
+        outputs[wave] = (prompt, stream)
+    engine.release(anchor_slot)
+    engine.flush()
+    assert engine.compile_counts["decode"] == 1
+    # only the anchor full-prefilled; every churned request hit its prefix
+    assert engine.prefill_calls == 1
+    assert engine.prefix_hits == 3
+    assert engine.prefix_tokens_saved == 3 * len(SYS)
+    # released slots are gone from the index
+    assert engine.prefix_index.resident() == {}
+    for wave, (prompt, stream) in outputs.items():
+        assert stream == reference(params, prompt, max_new), f"wave {wave}"
+
+
+def test_prefix_counters_in_stats_and_telemetry(params, tmp_path, tmp_env):
+    """prefix_hits / prefix_tokens_saved surface in scheduler stats and the
+    exported telemetry JSONL."""
+    from maggy_tpu.serve import Scheduler
+    from maggy_tpu.telemetry import worker_telemetry
+
+    tel = worker_telemetry("serve", str(tmp_path), role="serve")
+    engine = Engine(CFG, params, num_slots=4, prefix_reuse=True,
+                    telemetry_recorder=tel)
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        reqs = [
+            scheduler.submit(SYS + [60 + i], SamplingParams(max_new=3))
+            for i in range(3)
+        ]
+        import time
+
+        deadline = time.time() + 120
+        while time.time() < deadline and any(
+            r.state not in ("done", "failed") for r in reqs
+        ):
+            time.sleep(0.01)
+        assert all(r.state == "done" for r in reqs)
+        stats = scheduler.stats()
+        assert stats["prefix_hits"] == 2
+        assert stats["prefix_tokens_saved"] == 2 * len(SYS)
+        assert stats["prefill_calls"] == 1
+        assert stats["compile_counts"]["decode"] == 1
+    finally:
+        scheduler.stop()
+    tel.close()
+    path = os.path.join(str(tmp_path), "telemetry", "worker_serve.jsonl")
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    counters = {}
+    for rec in records:
+        if rec.get("kind") == "snapshot":
+            counters.update(rec.get("counters") or {})
+    assert counters.get("serve.prefix_hits") == 2, counters
+    assert counters.get("serve.prefix_tokens_saved") == 2 * len(SYS)
+    # the prefix admission leaves its span trail too
+    span_names = {r["name"] for r in records if r.get("kind") == "span"}
+    assert "serve.prefix_admit" in span_names, sorted(span_names)
