@@ -477,6 +477,111 @@ def bench_serve_drain(quick: bool = False):
     }
 
 
+def bench_trace_overhead(quick: bool = False):
+    """Request-tracing overhead (ISSUE 7): decode tok/s with the full
+    observability stack live — recorder spans/gauges, ambient trace id
+    tagged onto every record, drain histograms, flight-ring tee — vs the
+    null recorder. Two views: the wall-clock A/B (`overhead_pct_ab`,
+    noisy on a shared CPU box) and the deterministic model
+    (`overhead_pct` = measured per-step record-set cost / step time) that
+    gates the ~2% budget; the CI assertion (tests/test_tracing.py)
+    mirrors the latter."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.parallel.sharding import unbox
+    from maggy_tpu.serve import Engine, Request, SamplingParams
+    from maggy_tpu.telemetry import tracing
+    from maggy_tpu.telemetry.recorder import NullTelemetry, Telemetry
+
+    cfg = DecoderConfig.tiny(max_seq_len=256, dtype=jnp.float32)
+    params = unbox(
+        Decoder(cfg).init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    max_new = 60 if quick else 150
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10, 11]]
+
+    engines = {
+        mode: Engine(
+            cfg,
+            params,
+            num_slots=4,
+            telemetry_recorder=(
+                Telemetry(worker="bench-trace") if mode == "on" else NullTelemetry()
+            ),
+        )
+        for mode in ("off", "on")
+    }
+
+    def run(mode):
+        eng = engines[mode]
+        trace = tracing.new_trace_id() if mode == "on" else None
+        with tracing.scope(trace):
+            streams = {}
+            for p in prompts:
+                slot, first = eng.admit(
+                    Request(prompt=p, params=SamplingParams(max_new=max_new + 5))
+                )
+                streams[slot] = [first]
+            out = eng.step()  # warm the decode dispatch before timing
+            for s, t in out.tokens.items():
+                streams[s].append(t)
+            t0 = _time.perf_counter()
+            counted = 0
+            while any(len(v) < max_new for v in streams.values()):
+                out = eng.step()
+                for s, t in out.tokens.items():
+                    if len(streams[s]) < max_new:
+                        streams[s].append(t)
+                        counted += 1
+            dt = _time.perf_counter() - t0
+            for s in list(streams):
+                eng.release(s)
+            eng.flush()
+        return counted / dt
+
+    # interleaved best-of-N: CPU-box scheduling noise between two single
+    # runs easily exceeds the ~2% effect being measured
+    reps = 2 if quick else 3
+    best = {"off": 0.0, "on": 0.0}
+    for _ in range(reps):
+        for mode in ("off", "on"):
+            best[mode] = max(best[mode], run(mode))
+    tps_off, tps_on = best["off"], best["on"]
+    overhead_pct = (tps_off - tps_on) / tps_off * 100 if tps_off else None
+
+    # deterministic budget check: the wall-clock A/B above cannot resolve
+    # 2% under CPU scheduling jitter (run-to-run step variance is larger
+    # than the effect), so the gate is the directly measured per-step
+    # record-set cost against the decode step it rides on
+    tel = Telemetry(worker="bench-trace-model")
+    n = 5000
+    with tracing.scope(tracing.new_trace_id()):
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            with tel.span("serve.decode_step", active=4):
+                pass
+            tel.gauge("serve.drain_ms", 0.1)
+            tel.histogram("serve.drain_ms", 0.1)
+        recorder_us = (_time.perf_counter() - t0) / n * 1e6
+    # tokens/sec -> steps/sec: every step decodes one token per slot (4)
+    step_us = 4.0 / tps_on * 1e6 if tps_on else None
+    modeled_pct = recorder_us / step_us * 100 if step_us else None
+    return {
+        "tok_per_sec_tracing_off": round(tps_off, 1),
+        "tok_per_sec_tracing_on": round(tps_on, 1),
+        "overhead_pct_ab": (
+            round(overhead_pct, 2) if overhead_pct is not None else None
+        ),
+        "recorder_us_per_step": round(recorder_us, 2),
+        "overhead_pct": round(modeled_pct, 2) if modeled_pct is not None else None,
+        "within_budget": modeled_pct is not None and modeled_pct <= 2.0,
+    }
+
+
 def bench_fleet(quick: bool = False):
     """Serving fleet (maggy_tpu/serve/fleet, ISSUE 6): aggregate tok/s and
     TTFT p50/p95 at a FIXED offered load through the router with N=1 vs N=2
@@ -668,6 +773,7 @@ def main():
         input_pipeline_stats = None
         serve_drain_stats = None
         fleet_stats = None
+        trace_overhead_stats = None
     else:
         asha_stats = bench_asha_trials_per_hour(quick=args.quick)
         try:
@@ -694,6 +800,10 @@ def main():
             fleet_stats = bench_fleet(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
             fleet_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            trace_overhead_stats = bench_trace_overhead(quick=args.quick)
+        except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
+            trace_overhead_stats = {"error": f"{type(e).__name__}: {e}"}
 
     def rnd(v, digits):
         return None if v is None else round(v, digits)
@@ -720,6 +830,7 @@ def main():
             "input_pipeline": input_pipeline_stats,
             "serve_drain": serve_drain_stats,
             "fleet": fleet_stats,
+            "trace_overhead": trace_overhead_stats,
             "tuned": tuned or None,
         },
     }

@@ -41,6 +41,8 @@ from maggy_tpu.exceptions import (
     RpcRejectedError,
 )
 from maggy_tpu.resilience import chaos as chaos_mod
+from maggy_tpu.telemetry import flightrec
+from maggy_tpu.telemetry import tracing as tracing_mod
 
 _LEN = struct.Struct(">I")
 
@@ -300,28 +302,40 @@ class Server:
         if not secrets_mod.compare_digest(str(msg.get("secret", "")), self.secret):
             return {"type": "ERR", "error": "bad secret"}
         verb = msg.get("type", "")
-        ch = chaos_mod.get()
-        if ch is not None:
-            # chaos harness only: a matching rpc_stall rule delays this verb's
-            # reply — deliberately blocking the event loop, the way a wedged
-            # driver host stalls every connection at once
-            stall = ch.rpc_stall(verb)
-            if stall > 0:
-                time.sleep(stall)
-        handler = self.callbacks.get(verb)
-        if handler is None:
-            return {"type": "ERR", "error": f"unknown verb {verb!r}"}
-        tel = self.telemetry
-        t0 = time.perf_counter() if tel is not None else 0.0
+        # stall watchdog: the mark is armed for the whole dispatch —
+        # including an injected chaos stall, which wedges the event loop
+        # exactly like a stuck driver host — so a reply that never comes
+        # back trips a flight-recorder dump (docs/observability.md)
+        wd = flightrec.get()
+        wd.begin(f"rpc.{verb}")
         try:
-            reply = handler(msg)
-        except Exception as e:  # handler bugs must not kill the socket loop
+            ch = chaos_mod.get()
+            if ch is not None:
+                # chaos harness only: a matching rpc_stall rule delays this
+                # verb's reply — deliberately blocking the event loop, the
+                # way a wedged driver host stalls every connection at once
+                stall = ch.rpc_stall(verb)
+                if stall > 0:
+                    time.sleep(stall)
+            handler = self.callbacks.get(verb)
+            if handler is None:
+                return {"type": "ERR", "error": f"unknown verb {verb!r}"}
+            tel = self.telemetry
+            t0 = time.perf_counter() if tel is not None else 0.0
+            try:
+                # the frame's trace id becomes ambient for the handler, so
+                # everything it records correlates with the caller's request
+                with tracing_mod.scope(msg.get("trace")):
+                    reply = handler(msg)
+            except Exception as e:  # handler bugs must not kill the socket loop
+                if tel is not None:
+                    tel.rpc(f"srv.{verb}", (time.perf_counter() - t0) * 1e3, ok=False)
+                return {"type": "ERR", "error": f"{type(e).__name__}: {e}"}
             if tel is not None:
-                tel.rpc(f"srv.{verb}", (time.perf_counter() - t0) * 1e3, ok=False)
-            return {"type": "ERR", "error": f"{type(e).__name__}: {e}"}
-        if tel is not None:
-            tel.rpc(f"srv.{verb}", (time.perf_counter() - t0) * 1e3)
-        return reply if reply is not None else {"type": "OK"}
+                tel.rpc(f"srv.{verb}", (time.perf_counter() - t0) * 1e3)
+            return reply if reply is not None else {"type": "OK"}
+        finally:
+            wd.end(f"rpc.{verb}")
 
     # ------------------------------------------------------------------ helpers
 
@@ -401,6 +415,13 @@ class Client:
         (reference rpc.py:660-688)."""
         verb = msg.get("type", "?")
         msg = {**msg, "secret": self.secret, "partition_id": self.partition_id}
+        if "trace" not in msg:
+            # propagate the thread-ambient trace id on every frame — the
+            # server re-installs it around its handler, so one request's
+            # records correlate across processes (docs/observability.md)
+            trace = tracing_mod.current()
+            if trace is not None:
+                msg["trace"] = trace
         last_err: Optional[Exception] = None
         tel = self.telemetry
         for attempt in range(constants.RPC_MAX_RETRIES):

@@ -183,10 +183,51 @@ def _telemetry_lines(status: dict, width: int) -> list:
             )
         if "checkpoint_fallback" in c:
             parts.append(f"ckpt-fallback {c['checkpoint_fallback']}")
+        if "flightrec.dumps" in c:
+            # a stall dump is a red flag worth surfacing on the panel
+            parts.append(f"STALL-DUMPS {c['flightrec.dumps']}")
         if not parts:
             continue
         tag = pid if pid == "driver" else f"w{pid}"
         lines.append(f"{tag}: " + "  ".join(parts)[: width - 5])
+    return lines
+
+
+def _latency_parts(sv: dict) -> list:
+    """Histogram-derived latency summary for a serve/fleet SSTATS dict:
+    TTFT percentiles, TPOT, and SLO attainment when a budget is set
+    (docs/observability.md)."""
+    parts = []
+    if sv.get("ttft_ms_p50") is not None:
+        parts.append(f"ttft p50 {sv['ttft_ms_p50']:.0f}ms")
+    if sv.get("ttft_ms_p95") is not None:
+        parts.append(f"p95 {sv['ttft_ms_p95']:.0f}ms")
+    if sv.get("ttft_ms_p99") is not None:
+        parts.append(f"p99 {sv['ttft_ms_p99']:.0f}ms")
+    if sv.get("tpot_ms_p50") is not None:
+        parts.append(f"tpot {sv['tpot_ms_p50']:.1f}ms")
+    if sv.get("slo_attainment") is not None:
+        parts.append(
+            f"slo {100 * sv['slo_attainment']:.1f}%"
+            f" ({sv.get('slo_ok', 0)}/{sv.get('slo_ok', 0) + sv.get('slo_miss', 0)})"
+        )
+    return parts
+
+
+def _wrap_parts(parts: list, width: int) -> list:
+    """Flow ``parts`` onto as many panel lines as needed, breaking only at
+    part boundaries — the latency summary outgrew one line, and truncating
+    silently would hide the trailing parts (compile counts, SLO)."""
+    lines, cur = [], ""
+    for part in parts:
+        cand = f"{cur}  {part}" if cur else part
+        if cur and len(cand) > width:
+            lines.append(cur)
+            cur = part
+        else:
+            cur = cand
+    if cur:
+        lines.append(cur)
     return lines
 
 
@@ -266,12 +307,8 @@ def render_status(status: dict, width: int = 78) -> str:
                 f"prefix hits {sv['prefix_hits']} "
                 f"({sv.get('prefix_tokens_saved', 0)} tok saved)"
             )
-        if sv.get("ttft_ms_p50") is not None:
-            agg.append(f"ttft p50 {sv['ttft_ms_p50']:.0f}ms")
-        if sv.get("ttft_ms_p95") is not None:
-            agg.append(f"p95 {sv['ttft_ms_p95']:.0f}ms")
-        if agg:
-            lines.append("  ".join(agg)[:width])
+        agg.extend(_latency_parts(sv))
+        lines.extend(_wrap_parts(agg, width))
         for row in fleet.get("replicas") or []:
             bar = util.progress_bar(
                 row.get("active_slots", 0), max(row.get("num_slots", 1), 1),
@@ -310,14 +347,11 @@ def render_status(status: dict, width: int = 78) -> str:
         parts = [f"{sv.get('tokens_out', 0):,} tokens"]
         if sv.get("tokens_per_sec"):
             parts.append(f"{sv['tokens_per_sec']:,.0f} tok/s")
-        if sv.get("ttft_ms_p50") is not None:
-            parts.append(f"ttft p50 {sv['ttft_ms_p50']:.0f}ms")
-        if sv.get("ttft_ms_p95") is not None:
-            parts.append(f"p95 {sv['ttft_ms_p95']:.0f}ms")
+        parts.extend(_latency_parts(sv))
         compiles = (sv.get("compile_counts") or {}).get("decode")
         if compiles is not None:
             parts.append(f"decode compiles {compiles}")
-        lines.append("  ".join(parts)[:width])
+        lines.extend(_wrap_parts(parts, width))
         lines.extend(_telemetry_lines(status, width))
     elif status.get("workers_done") is not None:
         lines.append(

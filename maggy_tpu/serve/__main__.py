@@ -93,8 +93,10 @@ def main(argv=None) -> int:
                         help=">1 serves a fleet: a router front-end over N "
                              "engine replicas (docs/fleet.md)")
     parser.add_argument("--slo-ttft-ms", type=float,
-                        help="fleet admission SLO: shed/queue requests whose "
-                             "projected TTFT exceeds this")
+                        help="TTFT budget: fleet admission sheds/queues "
+                             "requests whose projected TTFT exceeds it; "
+                             "single-engine mode counts slo_ok/slo_miss "
+                             "attainment in SSTATS")
     parser.add_argument("--admission", choices=("queue", "shed"),
                         default="queue",
                         help="fleet behavior when projection exceeds the SLO")
@@ -175,7 +177,7 @@ def main(argv=None) -> int:
         engine = Engine(
             cfg, params, num_slots=args.slots, mesh=mesh, telemetry_recorder=tel
         )
-        scheduler = Scheduler(engine)
+        scheduler = Scheduler(engine, slo_ttft_ms=args.slo_ttft_ms)
         server = ServeServer(scheduler, secret=args.secret, name=args.name)
         host, port = server.start(host=args.host, port=args.port)
         what = "engine"

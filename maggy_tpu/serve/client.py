@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from maggy_tpu import constants
 from maggy_tpu.core import rpc
 from maggy_tpu.exceptions import RpcError, RpcRejectedError, ServerBusyError
+from maggy_tpu.telemetry import tracing
 
 
 class ServeClient:
@@ -96,7 +97,13 @@ class ServeClient:
         seed: int = 0,
         deadline_s: Optional[float] = None,
         retry_busy: int = 0,
+        trace: Optional[str] = None,
     ) -> str:
+        """Submit one request. A request-scoped ``trace`` id is minted here
+        (or adopted from the caller / ambient scope) and rides the SUBMIT
+        frame — the server stamps every lifecycle event with it, so the
+        request's whole cross-worker journey correlates in the exported
+        trace (docs/observability.md). Retried submits reuse the same id."""
         reply = self._call(
             {
                 "type": "SUBMIT",
@@ -107,6 +114,7 @@ class ServeClient:
                 "eos_id": eos_id,
                 "seed": seed,
                 "deadline_s": deadline_s,
+                "trace": trace or tracing.ensure(),
             },
             retry_busy=retry_busy,
         )

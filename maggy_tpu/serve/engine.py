@@ -185,6 +185,7 @@ class Engine:
         self._prefill_traces = 0
         self._admit_traces = 0
         self._prefix_traces = 0
+        self._last_compile_gauges = None
 
         self._decode_jit = jax.jit(self._decode_impl)
         self._admit_jit = jax.jit(self._admit_impl)
@@ -584,7 +585,9 @@ class Engine:
             return StepOutput(tokens={})
         t0 = time.perf_counter()
         sampled = np.asarray(pending["sampled"])  # sync: ok — lagged double-buffer drain
-        self.telemetry.gauge("serve.drain_ms", (time.perf_counter() - t0) * 1e3)
+        drain_ms = (time.perf_counter() - t0) * 1e3
+        self.telemetry.gauge("serve.drain_ms", drain_ms)
+        self.telemetry.histogram("serve.drain_ms", drain_ms)
         out: Dict[int, int] = {}
         for s, rid in pending["slots"].items():
             st = self.slots.get(s)
@@ -599,8 +602,14 @@ class Engine:
     # -------------------------------------------------------------- telemetry
 
     def _record_compile_gauges(self) -> None:
-        self.telemetry.gauge("serve.decode_retraces", self._decode_traces)
-        self.telemetry.gauge("serve.prefill_retraces", self._prefill_traces)
+        # journaled only on change: these tick on RETRACES (rare by
+        # design), and a per-step re-emit of two constant gauges was a
+        # measurable slice of the per-token telemetry budget
+        counts = (self._decode_traces, self._prefill_traces)
+        if counts != self._last_compile_gauges:
+            self._last_compile_gauges = counts
+            self.telemetry.gauge("serve.decode_retraces", self._decode_traces)
+            self.telemetry.gauge("serve.prefill_retraces", self._prefill_traces)
 
     @property
     def compile_counts(self) -> Dict[str, int]:

@@ -19,6 +19,7 @@ docs/fleet.md.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 from maggy_tpu.serve.fleet.replica import (  # noqa: F401
@@ -60,6 +61,10 @@ def launch_fleet(
         config = RouterConfig(**config_kwargs)
     elif config_kwargs:
         raise ValueError("pass either config= or RouterConfig kwargs, not both")
+    if spec.slo_ttft_ms is None and config.slo_ttft_ms is not None:
+        # thread the fleet SLO down so each replica's scheduler counts
+        # exact per-request attainment in its own SSTATS
+        spec = dataclasses.replace(spec, slo_ttft_ms=config.slo_ttft_ms)
     router = Router(
         build_replicas(spec, replicas, secret or "", host=host),
         config=config,

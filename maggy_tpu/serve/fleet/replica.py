@@ -42,6 +42,10 @@ class ReplicaSpec:
     mesh: Any = None
     async_decode: Optional[bool] = None
     prefix_reuse: Optional[bool] = None
+    # TTFT budget handed to each replica's scheduler so per-replica SSTATS
+    # carry exact slo_ok/slo_miss counters (launch_fleet seeds it from
+    # RouterConfig.slo_ttft_ms)
+    slo_ttft_ms: Optional[float] = None
     # index -> telemetry recorder, so each replica's gauges land in its own
     # worker JSONL (exported like any worker's)
     telemetry_factory: Optional[Callable[[int], Any]] = None
@@ -94,7 +98,9 @@ class Replica:
             prefix_reuse=spec.prefix_reuse,
         )
         self.server = ServeServer(
-            Scheduler(engine), secret=self.secret, name=f"replica-{self.index}"
+            Scheduler(engine, slo_ttft_ms=spec.slo_ttft_ms),
+            secret=self.secret,
+            name=f"replica-{self.index}",
         )
         self.addr = self.server.start(host=self.host, port=0)
         # the router's private client: plain single-shot calls — fleet-level

@@ -49,6 +49,9 @@ from maggy_tpu.exceptions import RpcError, RpcRejectedError
 from maggy_tpu.resilience import chaos as chaos_mod
 from maggy_tpu.resilience.policy import QuarantineTracker
 from maggy_tpu.serve.fleet.replica import DEAD, UP, Replica
+from maggy_tpu.serve.scheduler import LATENCY_SIGNALS
+from maggy_tpu.telemetry import tracing
+from maggy_tpu.telemetry.histogram import merge_dicts
 
 # router-side request states (downstream states pass through verbatim)
 PENDING = "pending"  # accepted, not yet on a replica
@@ -100,6 +103,10 @@ class RouteEntry:
 
     rid: str
     payload: Dict[str, Any]  # submit kwargs, replayable on requeue
+    # request-scoped trace id: adopted from the client's SUBMIT frame (or
+    # minted here for traceless clients) and forwarded on every downstream
+    # dispatch — durable across replica death, like the rid
+    trace: Optional[str] = None
     state: str = PENDING
     replica: Optional[int] = None
     remote_id: Optional[str] = None
@@ -134,6 +141,7 @@ class RouteEntry:
                 "done": False,
             }
         body["id"] = self.rid
+        body["trace"] = self.trace
         body["replica"] = self.replica
         body["resubmits"] = self.resubmits
         return body
@@ -178,6 +186,11 @@ class Router:
             "cancelled": 0,
             "respawned": 0,
         }
+        # exact SLO attainment at the fleet edge: counted per completed
+        # request against the configured TTFT budget (histogram-derived
+        # attainment in SSTATS is the bucket-resolution view of the same)
+        self.slo_ok = 0
+        self.slo_miss = 0
         self._log: deque = deque(maxlen=500)
         self._closing = False
         self._stop = threading.Event()
@@ -275,10 +288,16 @@ class Router:
     # ----------------------------------------------------------------- verbs
     # (event-loop thread: lock-guarded host state only, no sockets)
 
-    def _busy(self, why: str, projected: Optional[float] = None) -> Dict[str, Any]:
+    def _busy(
+        self,
+        why: str,
+        projected: Optional[float] = None,
+        trace: Optional[str] = None,
+    ) -> Dict[str, Any]:
         with self._lock:
             self.counters["shed"] += 1
         self.telemetry.count("fleet.shed")
+        self.telemetry.event("req.shed", trace=trace, reason=why)
         reply: Dict[str, Any] = {"type": "BUSY", "error": why}
         if projected is not None:
             reply["projected_ttft_ms"] = round(projected, 1)
@@ -328,6 +347,11 @@ class Router:
                         projected,
                     )
             rid = secrets_mod.token_hex(8)
+            # adopt the client's trace id (or mint one for traceless
+            # clients); it is forwarded on every downstream dispatch, so
+            # the request keeps ONE trace across router, replica, and any
+            # requeue-to-survivor hop
+            trace = msg.get("trace") or tracing.new_trace_id()
             payload = {
                 "prompt": [int(t) for t in prompt],
                 "temperature": float(msg.get("temperature", 0.0)),
@@ -335,14 +359,18 @@ class Router:
                 "max_new": int(msg.get("max_new", 16)),
                 "eos_id": int(msg.get("eos_id", -1)),
                 "seed": int(msg.get("seed", 0)),
+                "trace": trace,
             }
-            entry = RouteEntry(rid=rid, payload=payload)
+            entry = RouteEntry(rid=rid, payload=payload, trace=trace)
             deadline_s = msg.get("deadline_s")
             if deadline_s:
                 entry.deadline_ts = time.time() + float(deadline_s)
                 entry.payload["deadline_s"] = float(deadline_s)
             self._entries[rid] = entry
             self._pending.append(rid)
+        self.telemetry.event(
+            "req.accepted", trace=trace, rid=rid, plen=len(prompt)
+        )
         return {"type": "SUBMIT", "id": rid}
 
     def _on_poll(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -384,7 +412,15 @@ class Router:
         entry.counted_done = True
 
     def _fleet_stats(self) -> Dict[str, Any]:
-        """Aggregate + per-replica table (lock held)."""
+        """Aggregate + per-replica table (lock held).
+
+        Latency is merged honestly: every replica's SSTATS carries its raw
+        fixed-log-bucket histograms under ``latency``; those are added
+        bucket-wise per signal (TTFT/TPOT/queue-wait/e2e), so the fleet's
+        ``ttft_ms_p50/p90/p95/p99`` are true percentiles over ALL requests
+        — not the slowest replica's, not a mean of means. The merged
+        encodings ride out under ``latency`` for further aggregation
+        (docs/observability.md)."""
         now = time.time()
         table = []
         agg = {
@@ -398,7 +434,9 @@ class Router:
             "prefix_tokens_saved": 0,
             "prefill_calls": 0,
         }
-        p50s, p95s = [], []
+        latency_dicts: Dict[str, List[Dict[str, Any]]] = {
+            name: [] for name in LATENCY_SIGNALS
+        }
         for r in self.replicas:
             # in-process replicas answer fresh (lock-only, no sockets);
             # remote/dead ones fall back to the probe cache
@@ -416,6 +454,7 @@ class Router:
                 "prefix_hits": stats.get("prefix_hits", 0),
                 "prefix_tokens_saved": stats.get("prefix_tokens_saved", 0),
                 "ttft_ms_p50": stats.get("ttft_ms_p50"),
+                "ttft_ms_p95": stats.get("ttft_ms_p95"),
             }
             if quarantined:
                 row["state"] = "quarantined"
@@ -433,13 +472,37 @@ class Router:
                 "prefill_calls",
             ):
                 agg[k] += stats.get(k, 0)
-            if stats.get("ttft_ms_p50") is not None:
-                p50s.append(stats["ttft_ms_p50"])
-            if stats.get("ttft_ms_p95") is not None:
-                p95s.append(stats["ttft_ms_p95"])
-        # conservative fleet percentiles: the slowest replica bounds the SLO
-        agg["ttft_ms_p50"] = max(p50s) if p50s else None
-        agg["ttft_ms_p95"] = max(p95s) if p95s else None
+            for name, d in (stats.get("latency") or {}).items():
+                latency_dicts.setdefault(name, []).append(d)
+        merged = {
+            name: merge_dicts(ds) for name, ds in latency_dicts.items()
+        }
+        ttft = merged.get("ttft_ms")
+        for q, key in ((0.50, "p50"), (0.90, "p90"), (0.95, "p95"), (0.99, "p99")):
+            agg[f"ttft_ms_{key}"] = ttft.percentile(q) if ttft else None
+        tpot = merged.get("tpot_ms")
+        agg["tpot_ms_p50"] = tpot.percentile(0.50) if tpot else None
+        agg["tpot_ms_p95"] = tpot.percentile(0.95) if tpot else None
+        qw = merged.get("queue_wait_ms")
+        agg["queue_wait_ms_p50"] = qw.percentile(0.50) if qw else None
+        e2e = merged.get("e2e_ms")
+        agg["e2e_ms_p50"] = e2e.percentile(0.50) if e2e else None
+        agg["e2e_ms_p95"] = e2e.percentile(0.95) if e2e else None
+        agg["latency"] = {
+            name: h.to_dict() for name, h in merged.items() if h is not None
+        }
+        if self.config.slo_ttft_ms is not None:
+            agg["slo_ttft_ms"] = self.config.slo_ttft_ms
+            agg["slo_ok"] = self.slo_ok
+            agg["slo_miss"] = self.slo_miss
+            judged = self.slo_ok + self.slo_miss
+            # exact edge counters when available; the merged histogram's
+            # bucket-interpolated view stands in before any completion
+            agg["slo_attainment"] = (
+                self.slo_ok / judged
+                if judged
+                else (ttft.attainment(self.config.slo_ttft_ms) if ttft else None)
+            )
         return {
             **agg,
             "replicas": table,
@@ -560,6 +623,7 @@ class Router:
                 return
             self._down_handled.add(replica.index)
             moved = 0
+            requeued_entries = []
             for entry in self._entries.values():
                 if entry.replica == replica.index and not entry.done():
                     entry.state = REQUEUED
@@ -568,8 +632,17 @@ class Router:
                     entry.snapshot = None
                     entry.resubmits += 1
                     self._pending.appendleft(entry.rid)
+                    requeued_entries.append(entry)
                     moved += 1
             self.counters["requeued"] += moved
+        for entry in requeued_entries:
+            # explicit hop milestone: the SAME trace id continues on the
+            # survivor, so the exported lane shows the loss + re-run inline
+            self.telemetry.event(
+                "req.requeued", trace=entry.trace, rid=entry.rid,
+                replica=replica.index, resubmits=entry.resubmits,
+            )
+        with self._lock:
             self._stats_cache.pop(replica.index, None)
             respawn = (
                 replica.state == DEAD
@@ -659,6 +732,13 @@ class Router:
                 ):
                     return  # hold fresh work until capacity projects in-SLO
                 self._pending.popleft()
+            # milestone BEFORE the downstream round-trip: the replica's own
+            # req.queued lands mid-flight, so stamping after the reply
+            # would scramble the lane's dispatched→queued ordering
+            self.telemetry.event(
+                "req.dispatched", trace=entry.trace, rid=entry.rid,
+                replica=best.index, resubmits=entry.resubmits,
+            )
             try:
                 remote_id = best.client.submit(**entry.payload)
             except RpcRejectedError as e:
@@ -702,6 +782,7 @@ class Router:
                 snap = replica.client.poll(remote_id)
             except RpcRejectedError:
                 # replica forgot the id (restart/retention): replay it
+                requeued_entry = None
                 with self._lock:
                     entry = self._entries.get(rid)
                     if entry is not None and not entry.done():
@@ -712,10 +793,17 @@ class Router:
                         entry.resubmits += 1
                         self.counters["requeued"] += 1
                         self._pending.appendleft(rid)
+                        requeued_entry = entry
+                if requeued_entry is not None:
+                    self.telemetry.event(
+                        "req.requeued", trace=requeued_entry.trace, rid=rid,
+                        replica=idx, resubmits=requeued_entry.resubmits,
+                    )
                 continue
             except (RpcError, OSError) as e:
                 self._note_failure(replica, f"poll: {type(e).__name__}")
                 return
+            completed = None
             with self._lock:
                 entry = self._entries.get(rid)
                 if entry is None or entry.state != ROUTED:
@@ -730,3 +818,20 @@ class Router:
                         "failed": "failed",
                     }.get(snap.get("state"), "completed")
                     self.counters[key] += 1
+                    completed = entry
+                    # exact fleet-edge SLO attainment, judged on the TTFT
+                    # the serving replica measured for this request
+                    if (
+                        self.config.slo_ttft_ms is not None
+                        and snap.get("ttft_ms") is not None
+                    ):
+                        if snap["ttft_ms"] <= self.config.slo_ttft_ms:
+                            self.slo_ok += 1
+                        else:
+                            self.slo_miss += 1
+            if completed is not None:
+                self.telemetry.event(
+                    "req.completed", trace=completed.trace, rid=rid,
+                    state=snap.get("state"), replica=idx,
+                    resubmits=completed.resubmits,
+                )

@@ -51,6 +51,10 @@ class Request:
     prompt: List[int]
     params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     id: str = dataclasses.field(default_factory=lambda: secrets.token_hex(8))
+    # request-scoped trace id (docs/observability.md): arrives on the SUBMIT
+    # frame (client- or router-minted), else minted at scheduler admission;
+    # every lifecycle event this request produces carries it
+    trace: Optional[str] = None
     state: str = QUEUED
     tokens: List[int] = dataclasses.field(default_factory=list)
     error: Optional[str] = None
@@ -70,6 +74,28 @@ class Request:
             return None
         return (self.first_token_ts - self.submitted_ts) * 1e3
 
+    @property
+    def queue_wait_ms(self) -> Optional[float]:
+        if self.admitted_ts is None:
+            return None
+        return (self.admitted_ts - self.submitted_ts) * 1e3
+
+    @property
+    def e2e_ms(self) -> Optional[float]:
+        if self.done_ts is None:
+            return None
+        return (self.done_ts - self.submitted_ts) * 1e3
+
+    @property
+    def tpot_ms(self) -> Optional[float]:
+        """Mean per-token decode time after the first token (the Gemma
+        serving comparison's TPOT); needs >= 2 tokens and a terminal ts."""
+        if self.done_ts is None or self.first_token_ts is None:
+            return None
+        if len(self.tokens) < 2:
+            return None
+        return (self.done_ts - self.first_token_ts) * 1e3 / (len(self.tokens) - 1)
+
     def finish(self, state: str, error: Optional[str] = None) -> None:
         self.state = state
         self.error = error
@@ -79,6 +105,7 @@ class Request:
         """Wire-format view for the POLL verb (JSON-safe, no live refs)."""
         return {
             "id": self.id,
+            "trace": self.trace,
             "state": self.state,
             "tokens": list(self.tokens),
             "n_tokens": len(self.tokens),

@@ -14,6 +14,16 @@ Telemetry (continuously, into the ambient or provided recorder):
 docs/performance.md), and the engine's retrace gauges. Counters:
 ``serve.requests_{submitted,done,cancelled,expired,failed,rejected}`` and
 ``serve.tokens_out``.
+
+Request-scoped observability (docs/observability.md): every request carries
+a trace id (from the SUBMIT frame, else minted here) and emits lifecycle
+events under it — ``req.queued`` → ``req.admitted``/``req.prefix_admitted``
+→ ``req.first_token`` → ``req.finished`` — while fixed-log-bucket
+histograms aggregate TTFT, TPOT, queue-wait, and e2e latency
+(scheduler-owned, for SSTATS percentiles and the router's fleet-level
+merge; mirrored into the recorder for JSONL/monitor snapshots). The engine
+loop arms a ``serve.loop`` stall-watchdog mark, so a wedged step loop dumps
+the flight recorder instead of dying silently.
 """
 
 from __future__ import annotations
@@ -28,6 +38,12 @@ from maggy_tpu.exceptions import BadArgumentsError
 from maggy_tpu.serve import request as rq
 from maggy_tpu.serve.engine import Engine
 from maggy_tpu.serve.request import Request, SamplingParams
+from maggy_tpu.telemetry import flightrec, tracing
+from maggy_tpu.telemetry.histogram import LatencyHistogram
+
+# the latency signals the scheduler aggregates (histogram per signal);
+# SSTATS exposes raw buckets under "latency" plus derived percentiles
+LATENCY_SIGNALS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms")
 
 # terminal requests stay pollable this long after finishing
 RETENTION_S = 300.0
@@ -42,6 +58,7 @@ class Scheduler:
         max_queue: int = 1024,
         telemetry_recorder=None,
         retention_s: float = RETENTION_S,
+        slo_ttft_ms: Optional[float] = None,
     ):
         self.engine = engine
         self.max_queue = max_queue
@@ -53,7 +70,18 @@ class Scheduler:
         self._requests: Dict[str, Request] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._ttft_ms: deque = deque(maxlen=512)
+        # scheduler-owned latency histograms (replacing the old 512-entry
+        # TTFT deque): unbounded sample count, O(1) observe, mergeable at
+        # the router. Written by the loop thread, serialized in stats()
+        # under the lock.
+        self._hist: Dict[str, LatencyHistogram] = {
+            name: LatencyHistogram() for name in LATENCY_SIGNALS
+        }
+        # SLO attainment: exact per-request TTFT-vs-budget counters when an
+        # SLO is configured (the fleet router sets its own from RouterConfig)
+        self.slo_ttft_ms = None if slo_ttft_ms is None else float(slo_ttft_ms)
+        self.slo_ok = 0
+        self.slo_miss = 0
         self._started_ts = time.time()
         self._tok_rate_ema = 0.0
         self.counters: Dict[str, int] = {
@@ -73,6 +101,7 @@ class Scheduler:
         prompt: List[int],
         params: Optional[SamplingParams] = None,
         deadline_s: Optional[float] = None,
+        trace: Optional[str] = None,
     ) -> Request:
         params = params or SamplingParams()
         params.validate()
@@ -84,6 +113,10 @@ class Scheduler:
                 f"exceeds max_seq_len ({self.engine.max_seq_len})"
             )
         req = Request(prompt=[int(t) for t in prompt], params=params)
+        # adopt the caller's trace id (SUBMIT frame / ambient RPC scope) so
+        # the request's lifecycle correlates with its client-side journey;
+        # direct in-process submits get a fresh one
+        req.trace = trace or tracing.ensure()
         if deadline_s is not None:
             req.deadline_ts = time.time() + float(deadline_s)
         with self._wake:
@@ -96,6 +129,10 @@ class Scheduler:
             self._requests[req.id] = req
             self.counters["submitted"] += 1
             self._wake.notify_all()
+        self.telemetry.event(
+            "req.queued", trace=req.trace, rid=req.id,
+            plen=len(req.prompt), max_new=params.max_new,
+        )
         return req
 
     def poll(self, request_id: str) -> Dict[str, Any]:
@@ -122,15 +159,22 @@ class Scheduler:
         """One consistent snapshot, built entirely under the scheduler lock.
 
         The router polls SSTATS concurrently with the engine loop; every
-        mutable structure read here (queue, counters, TTFT deque) is copied
+        mutable structure read here (queue, counters, histograms) is copied
         while the lock is held so a mid-iteration mutation can never tear the
         snapshot (dict-changed-size during iteration) or mix counters from
         two different instants. Engine counters are plain ints the scheduler
-        thread owns — single reads are atomic under the GIL."""
+        thread owns — single reads are atomic under the GIL.
+
+        Latency surfaces: derived percentiles (``ttft_ms_p50/p90/p95/p99``,
+        ``tpot_ms_p50/p95``, ``queue_wait_ms_p50``, ``e2e_ms_p50/p95``) plus
+        the raw bucket encodings under ``latency`` — the router merges those
+        bucket-wise into fleet-level distributions. With ``slo_ttft_ms``
+        set, ``slo_ok``/``slo_miss``/``slo_attainment`` report SLO health."""
         with self._lock:
-            ttft = sorted(self._ttft_ms)
             counters = dict(self.counters)
             queue_depth = len(self._queue)
+            hists = {name: h.copy() for name, h in self._hist.items()}
+            slo = (self.slo_ttft_ms, self.slo_ok, self.slo_miss)
             engine = self.engine
             snap = {
                 "queue_depth": queue_depth,
@@ -143,9 +187,23 @@ class Scheduler:
                 "compile_counts": engine.compile_counts,
                 **engine.prefix_stats,
             }
-        pct = lambda q: ttft[min(len(ttft) - 1, int(q * len(ttft)))] if ttft else None  # noqa: E731
-        snap["ttft_ms_p50"] = pct(0.50)
-        snap["ttft_ms_p95"] = pct(0.95)
+        ttft = hists["ttft_ms"]
+        snap["ttft_ms_p50"] = ttft.percentile(0.50)
+        snap["ttft_ms_p90"] = ttft.percentile(0.90)
+        snap["ttft_ms_p95"] = ttft.percentile(0.95)
+        snap["ttft_ms_p99"] = ttft.percentile(0.99)
+        snap["tpot_ms_p50"] = hists["tpot_ms"].percentile(0.50)
+        snap["tpot_ms_p95"] = hists["tpot_ms"].percentile(0.95)
+        snap["queue_wait_ms_p50"] = hists["queue_wait_ms"].percentile(0.50)
+        snap["e2e_ms_p50"] = hists["e2e_ms"].percentile(0.50)
+        snap["e2e_ms_p95"] = hists["e2e_ms"].percentile(0.95)
+        snap["latency"] = {name: h.to_dict() for name, h in hists.items()}
+        slo_ms, ok, miss = slo
+        if slo_ms is not None:
+            snap["slo_ttft_ms"] = slo_ms
+            snap["slo_ok"] = ok
+            snap["slo_miss"] = miss
+            snap["slo_attainment"] = ok / (ok + miss) if (ok + miss) else None
         snap.update({f"requests_{k}": v for k, v in counters.items()})
         return snap
 
@@ -189,16 +247,38 @@ class Scheduler:
             rq.FAILED: "failed",
         }[state]
         self.counters[key] += 1
-        self.telemetry.count(f"serve.requests_{key}")
+        tel = self.telemetry
+        tel.count(f"serve.requests_{key}")
+        if req.e2e_ms is not None:
+            self._hist["e2e_ms"].observe(req.e2e_ms)
+            tel.histogram("serve.e2e_ms", req.e2e_ms)
+        if req.tpot_ms is not None:
+            self._hist["tpot_ms"].observe(req.tpot_ms)
+            tel.histogram("serve.tpot_ms", req.tpot_ms)
+        tel.event(
+            "req.finished", trace=req.trace, rid=req.id, state=state,
+            n_tokens=len(req.tokens), e2e_ms=req.e2e_ms,
+        )
 
     def _emit(self, req: Request, token: int, now: float) -> bool:
         """Append a generated token; True when the request just finished."""
         req.tokens.append(int(token))
         if req.first_token_ts is None:
             req.first_token_ts = now
-            if req.ttft_ms is not None:
-                self._ttft_ms.append(req.ttft_ms)
-                self.telemetry.gauge("serve.ttft_ms", req.ttft_ms)
+            ttft = req.ttft_ms
+            if ttft is not None:
+                self._hist["ttft_ms"].observe(ttft)
+                tel = self.telemetry
+                tel.gauge("serve.ttft_ms", ttft)
+                tel.histogram("serve.ttft_ms", ttft)
+                tel.event(
+                    "req.first_token", trace=req.trace, rid=req.id, ttft_ms=ttft
+                )
+                if self.slo_ttft_ms is not None:
+                    if ttft <= self.slo_ttft_ms:
+                        self.slo_ok += 1
+                    else:
+                        self.slo_miss += 1
         p = req.params
         if (p.eos_id >= 0 and int(token) == p.eos_id) or len(req.tokens) >= p.max_new:
             self._finish(req, rq.DONE)
@@ -220,15 +300,33 @@ class Scheduler:
                 with self._lock:
                     self._finish(req, rq.EXPIRED, "deadline exceeded in queue")
                 continue
+            # admission milestone BEFORE the prefill device work, so the
+            # trace lane's queued→admitted gap is pure queue wait and
+            # admitted→first_token is the prefill (docs/observability.md);
+            # the prefix decision is re-read from the same deterministic
+            # index match admit() itself will make
+            req.admitted_ts = time.time()
+            wait_ms = req.queue_wait_ms
+            prefix_hit = self.engine._match_prefix(req.prompt) is not None
+            tel = self.telemetry
+            if wait_ms is not None:
+                self._hist["queue_wait_ms"].observe(wait_ms)
+                tel.histogram("serve.queue_wait_ms", wait_ms)
+            tel.event(
+                "req.prefix_admitted" if prefix_hit else "req.admitted",
+                trace=req.trace, rid=req.id, queue_wait_ms=wait_ms,
+            )
             try:
-                slot, first = self.engine.admit(req)
+                # the request's trace becomes ambient for the admission, so
+                # the engine's prefill/prefix-admit spans correlate with it
+                with tracing.scope(req.trace):
+                    slot, first = self.engine.admit(req)
             except Exception as e:  # noqa: BLE001 - a poison request must not kill the loop
                 with self._lock:
                     self._finish(req, rq.FAILED, f"{type(e).__name__}: {e}")
                 continue
             with self._lock:
                 req.state = rq.RUNNING
-                req.admitted_ts = now
                 if self._emit(req, first, time.time()):
                     self.engine.release(slot)
 
@@ -258,7 +356,19 @@ class Scheduler:
     def _loop(self) -> None:
         tel = self.telemetry
         last_flush = time.time()
+        # stall watchdog: the loop beats every iteration (including idle
+        # waits); a wedged engine step stops the beats and dumps the flight
+        # recorder instead of hanging silently (docs/observability.md)
+        wd = flightrec.get()
+        wd.begin("serve.loop")
+        try:
+            self._loop_body(tel, last_flush, wd)
+        finally:
+            wd.end("serve.loop")
+
+    def _loop_body(self, tel, last_flush, wd) -> None:
         while not self._stop.is_set():
+            wd.beat("serve.loop")
             now = time.time()
             self._sweep_active(now)
             self._admit_ready(now)

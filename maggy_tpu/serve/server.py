@@ -95,7 +95,12 @@ class ServeServer:
         )
         deadline_s = msg.get("deadline_s")
         req = self.scheduler.submit(
-            prompt, params, deadline_s=float(deadline_s) if deadline_s else None
+            prompt,
+            params,
+            deadline_s=float(deadline_s) if deadline_s else None,
+            # the frame's trace id (client- or router-minted) keeps this
+            # request's lifecycle correlated end to end
+            trace=msg.get("trace"),
         )
         self.log(f"submit {req.id} len={len(prompt)} max_new={params.max_new}")
         return {"type": "SUBMIT", "id": req.id}

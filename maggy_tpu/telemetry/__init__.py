@@ -12,8 +12,17 @@ structured layer every tier threads through:
   records land under ``<exp_dir>/telemetry/worker_<pid>.jsonl`` identically on
   a local disk or ``gs://``.
 * :mod:`maggy_tpu.telemetry.export` — merges every worker's JSONL into one
-  Chrome-trace (Perfetto-loadable) JSON on the shared wall-clock base, and
-  mirrors gauge series into TensorBoard scalars via the tensorboard.py seam.
+  Chrome-trace (Perfetto-loadable) JSON on the shared wall-clock base —
+  including one lane per traced request — and mirrors gauge series into
+  TensorBoard scalars via the tensorboard.py seam.
+* :mod:`maggy_tpu.telemetry.tracing` — request-scoped trace ids, minted at
+  the edge and propagated on every RPC frame; records tagged automatically.
+* :mod:`maggy_tpu.telemetry.histogram` — fixed-log-bucket latency
+  histograms (TTFT/TPOT/queue-wait/e2e), mergeable across replicas.
+* :mod:`maggy_tpu.telemetry.flightrec` — stall watchdog + flight recorder:
+  bounded event rings plus thread-stack dumps when a progress loop wedges.
+* :mod:`maggy_tpu.telemetry.metrics` — the checked-in metric-name registry
+  ``tools/check_telemetry_names.py`` enforces.
 
 Wiring: executors build a worker recorder (:func:`worker_telemetry`), install
 it as the thread-ambient recorder (``Trainer.fit`` and ``Checkpointer`` pick
@@ -24,6 +33,8 @@ driver folds into STATUS for the live monitor panel.
 
 from __future__ import annotations
 
+from maggy_tpu.telemetry import flightrec, tracing  # noqa: F401
+from maggy_tpu.telemetry.histogram import LatencyHistogram  # noqa: F401
 from maggy_tpu.telemetry.recorder import (  # noqa: F401
     NULL,
     NullTelemetry,
@@ -46,4 +57,7 @@ __all__ = [
     "JsonlSink",
     "telemetry_dir",
     "worker_telemetry",
+    "LatencyHistogram",
+    "tracing",
+    "flightrec",
 ]

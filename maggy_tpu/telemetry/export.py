@@ -6,6 +6,15 @@ become complete (``ph="X"``) events and gauges become counter (``ph="C"``)
 events, all on the shared wall-clock microsecond base the recorder stamps, so
 spans from different workers/hosts interleave correctly on one timeline.
 
+Request lanes: lifecycle events (``kind="event"``) carrying a trace id are
+additionally folded into a synthetic ``requests`` process — one thread
+(lane) per trace, with the raw milestones as instants and the gaps between
+consecutive milestones rendered as labeled phase spans (``route`` /
+``queue`` / ``prefill`` / ``decode`` / ``lost`` …). Because the trace id is
+propagated across workers (docs/observability.md), a request that hopped
+client → router → replica → survivor renders as ONE contiguous lane even
+though its records came from several worker files.
+
 ``mirror_to_tensorboard`` replays each worker's gauge series through the
 existing :mod:`maggy_tpu.tensorboard` seam (``events.jsonl`` always, real TF
 event files when the tensorboard package is importable).
@@ -31,17 +40,35 @@ def _worker_pid(worker: Any, assigned: Dict[str, int]) -> int:
     return assigned[s]
 
 
+def _jsonl_segments(names: List[str]) -> List[Any]:
+    """(stem, path-name) pairs for every JSONL file including rotated
+    segments (``x.jsonl.3``), ordered oldest-first within each stem so a
+    rotated worker's records concatenate in write order."""
+    entries = []
+    for name in names:
+        stem, sep, suffix = name.partition(".jsonl")
+        if not sep:
+            continue
+        if suffix and not (suffix.startswith(".") and suffix[1:].isdigit()):
+            continue  # e.g. trace.json / stray temp files
+        seg = int(suffix[1:]) if suffix else 0
+        entries.append((stem, -seg, name))
+    return [(stem, name) for stem, _seg, name in sorted(entries)]
+
+
 def load_records(env, exp_dir: str) -> Dict[str, List[Dict[str, Any]]]:
-    """All telemetry JSONL records under ``exp_dir``, keyed by file stem.
-    Unparseable lines are skipped — a crashed worker may leave a torn tail."""
+    """All telemetry JSONL records under ``exp_dir``, keyed by file stem —
+    rotated segments (``worker_0.jsonl.1`` …) fold into their stem oldest
+    first. Unparseable lines are skipped — a crashed worker may leave a
+    torn tail."""
     tdir = telemetry_dir(exp_dir)
     out: Dict[str, List[Dict[str, Any]]] = {}
     try:
-        names = [n for n in env.listdir(tdir) if n.endswith(".jsonl")]
+        names = list(env.listdir(tdir))
     except OSError:
         return out
-    for name in names:
-        records = []
+    for stem, name in _jsonl_segments(names):
+        records = out.setdefault(stem, [])
         try:
             with env.open_file(posixpath.join(tdir, name), "r") as f:
                 for line in f:
@@ -54,9 +81,87 @@ def load_records(env, exp_dir: str) -> Dict[str, List[Dict[str, Any]]]:
                         continue
         except OSError:
             continue
-        if records:
-            out[name[: -len(".jsonl")]] = records
-    return out
+    return {stem: records for stem, records in out.items() if records}
+
+
+# synthetic process id for the per-request lanes (well clear of worker pids:
+# numeric partition ids and the 1000+ named-worker slots)
+REQUESTS_PID = 9000
+
+# (previous milestone, this milestone) -> phase-span label on a request lane
+_PHASE_LABELS: Dict[Any, str] = {
+    ("req.accepted", "req.dispatched"): "route",
+    ("req.requeued", "req.dispatched"): "route",
+    ("req.accepted", "req.shed"): "route",
+    ("req.dispatched", "req.queued"): "transit",
+    ("req.accepted", "req.queued"): "transit",
+    ("req.queued", "req.admitted"): "queue",
+    ("req.queued", "req.prefix_admitted"): "queue",
+    ("req.admitted", "req.first_token"): "prefill",
+    ("req.prefix_admitted", "req.first_token"): "prefill",
+    ("req.first_token", "req.finished"): "decode",
+    ("req.finished", "req.completed"): "completion",
+    ("req.queued", "req.finished"): "queue",
+}
+
+
+def _request_lanes(
+    traces: Dict[str, List[Dict[str, Any]]], events: List[Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Fold per-trace lifecycle events into lane metadata + instants +
+    phase spans under the synthetic ``requests`` process. Returns the
+    thread-name metadata records (the events are appended in place)."""
+    meta: List[Dict[str, Any]] = []
+    order = sorted(traces, key=lambda t: min(float(e["ts"]) for e in traces[t]))
+    for tid, trace in enumerate(order, start=1):
+        recs = sorted(traces[trace], key=lambda e: float(e["ts"]))
+        rid = None
+        for rec in recs:
+            rid = (rec.get("attrs") or {}).get("rid") or rid
+        meta.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "ts": 0,
+                "pid": REQUESTS_PID,
+                "tid": tid,
+                "args": {"name": f"req {rid or trace}"},
+            }
+        )
+        for rec in recs:
+            events.append(
+                {
+                    "name": rec.get("name", "?"),
+                    "cat": "request",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": int(float(rec["ts"]) * 1e6),
+                    "pid": REQUESTS_PID,
+                    "tid": tid,
+                    "args": {"trace": trace, **(rec.get("attrs") or {})},
+                }
+            )
+        for prev, cur in zip(recs, recs[1:]):
+            t0, t1 = float(prev["ts"]), float(cur["ts"])
+            if t1 <= t0:
+                continue
+            label = _PHASE_LABELS.get(
+                (prev.get("name"), cur.get("name")),
+                "lost" if cur.get("name") == "req.requeued" else "other",
+            )
+            events.append(
+                {
+                    "name": label,
+                    "cat": "request",
+                    "ph": "X",
+                    "ts": int(t0 * 1e6),
+                    "dur": max(1, int((t1 - t0) * 1e6)),
+                    "pid": REQUESTS_PID,
+                    "tid": tid,
+                    "args": {"trace": trace},
+                }
+            )
+    return meta
 
 
 def export_chrome_trace(env, exp_dir: str, out_name: str = "trace.json") -> Optional[str]:
@@ -68,6 +173,7 @@ def export_chrome_trace(env, exp_dir: str, out_name: str = "trace.json") -> Opti
     assigned: Dict[str, int] = {}
     events: List[Dict[str, Any]] = []
     seen_pids: Dict[int, str] = {}
+    traces: Dict[str, List[Dict[str, Any]]] = {}
     for stem, records in sorted(by_worker.items()):
         for rec in records:
             worker = rec.get("worker", stem)
@@ -77,7 +183,11 @@ def export_chrome_trace(env, exp_dir: str, out_name: str = "trace.json") -> Opti
             if ts is None:
                 continue
             kind = rec.get("kind")
+            trace = rec.get("trace")
             if kind == "span":
+                args = dict(rec.get("attrs") or {})
+                if trace:
+                    args["trace"] = trace
                 events.append(
                     {
                         "name": rec.get("name", "?"),
@@ -87,7 +197,7 @@ def export_chrome_trace(env, exp_dir: str, out_name: str = "trace.json") -> Opti
                         "dur": max(1, int(float(rec.get("dur_ms", 0.0)) * 1e3)),
                         "pid": pid,
                         "tid": int(rec.get("tid", 0)),
-                        "args": rec.get("attrs") or {},
+                        "args": args,
                     }
                 )
             elif kind == "gauge":
@@ -102,6 +212,11 @@ def export_chrome_trace(env, exp_dir: str, out_name: str = "trace.json") -> Opti
                         "args": {rec.get("name", "value"): rec.get("value")},
                     }
                 )
+            elif kind == "event" and trace:
+                # request lanes are cross-worker: bucket by trace id now,
+                # fold into the synthetic process after the sweep
+                traces.setdefault(trace, []).append(rec)
+    lane_meta = _request_lanes(traces, events) if traces else []
     if not events:
         return None
     events.sort(key=lambda e: e["ts"])
@@ -117,6 +232,18 @@ def export_chrome_trace(env, exp_dir: str, out_name: str = "trace.json") -> Opti
         }
         for pid, label in sorted(seen_pids.items())
     ]
+    if lane_meta:
+        meta.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "ts": 0,
+                "pid": REQUESTS_PID,
+                "tid": 0,
+                "args": {"name": "requests"},
+            }
+        )
+        meta.extend(lane_meta)
     path = posixpath.join(telemetry_dir(exp_dir), out_name)
     env.dump(
         json.dumps(

@@ -1,0 +1,133 @@
+"""Checked-in metric-name registry.
+
+Every ``gauge``/``counter``/``histogram``/``event`` name the codebase emits
+must appear here — ``tools/check_telemetry_names.py`` (wired into tier-1)
+walks ``maggy_tpu/`` and fails on any telemetry call whose literal name is
+missing. The failure mode this kills: a typo'd name (``serve.ttft_m``)
+silently splits a series into two, and every dashboard/percentile downstream
+quietly reads half the data.
+
+Keep this module import-light (stdlib only): the lint loads it by file path
+without importing the package, so it must not pull jax or anything heavy.
+
+Adding a metric = add the name here (grouped by kind, with a one-line
+meaning) + emit it. Names are grouped per kind because a name may legally
+be both a gauge (latest value, monitor panel) and a histogram (full
+distribution, SSTATS percentiles) — ``serve.ttft_ms`` is.
+"""
+
+from __future__ import annotations
+
+# gauges: latest-value signals (monitor panels, heartbeat snapshots)
+GAUGES = frozenset(
+    {
+        # training loop (train/trainer.py)
+        "step_time_ms",  # host wall clock per step
+        "step_time_ms_mean",  # mean over the run, compile step excluded
+        "compile_time_ms",  # first step, synced to cover the XLA compile
+        "steps_per_sec",
+        "tokens_per_sec",
+        "mfu_est",  # 6*params FLOPs estimate vs detected chip peak
+        "metrics_lag",  # steps between a broadcast and its metric
+        "metrics_drain_ms",  # host time in the lagged broadcast read
+        "resumed_step",  # resume="auto" restore point
+        # input pipeline (train/prefetch.py)
+        "input_wait_ms",
+        "prefetch_depth",
+        # checkpointing (train/checkpoint.py)
+        "checkpoint_save_ms",
+        # control plane (core/rpc.py, core/pod.py)
+        "heartbeat_rtt_ms",
+        "data_plane_init_ms",
+        "driver_connect_ms",
+        # serving engine + scheduler (serve/)
+        "serve.ttft_ms",
+        "serve.tokens_per_sec",
+        "serve.queue_depth",
+        "serve.active_slots",
+        "serve.drain_ms",
+        "serve.decode_retraces",
+        "serve.prefill_retraces",
+        # serving fleet (serve/fleet/)
+        "fleet.healthy_replicas",
+        # autotuner (tune/)
+        "tune.candidates",
+        "tune.pruned_oom",
+        "tune.best_step_time",
+    }
+)
+
+# counters: monotonic totals
+COUNTERS = frozenset(
+    {
+        "trials_done",
+        "trials_errored",
+        "checkpoint_fallback",
+        "serve.prefix_hits",
+        "serve.prefix_tokens_saved",
+        "fleet.shed",
+        "fleet.quarantined",
+        "fleet.requeued",
+        "fleet.routed",
+        "resilience.auto_resumes",
+        "resilience.preempt_saves",
+        "resilience.worker_deaths",
+        "resilience.workers_quarantined",
+        "resilience.trials_requeued",
+        "resilience.trials_exhausted",
+        "resilience.dist_restarts",
+        "tune.cache_hits",
+        "tune.cache_misses",
+        "flightrec.dumps",  # stall watchdog dumps written (telemetry/flightrec.py)
+    }
+)
+
+# histograms: fixed-log-bucket latency distributions (telemetry/histogram.py)
+HISTOGRAMS = frozenset(
+    {
+        "serve.ttft_ms",  # submit -> first token
+        "serve.tpot_ms",  # per-token decode time after the first
+        "serve.queue_wait_ms",  # submit -> admission
+        "serve.e2e_ms",  # submit -> terminal state
+        "serve.drain_ms",  # async decode host drain
+    }
+)
+
+# lifecycle events: trace-correlated milestones (telemetry/tracing.py)
+EVENTS = frozenset(
+    {
+        # serving request lifecycle (scheduler-side)
+        "req.queued",
+        "req.admitted",
+        "req.prefix_admitted",
+        "req.first_token",
+        "req.finished",
+        # router-side hops (serve/fleet/router.py)
+        "req.accepted",
+        "req.dispatched",
+        "req.requeued",
+        "req.shed",
+        "req.completed",
+        # training runs (train/trainer.py)
+        "train.run_start",
+        "train.run_end",
+    }
+)
+
+# f-string names whose literal head is one of these prefixes are legal
+# (the tail is a bounded enum resolved at runtime: request terminal states,
+# RPC verbs)
+DYNAMIC_PREFIXES = (
+    "serve.requests_",  # scheduler terminal-state counters
+    "rpc_errors.",  # per-verb client failures (recorder.rpc)
+    "rpc_frame_errors.",  # server frame hygiene (core/rpc.py)
+)
+
+BY_KIND = {
+    "gauge": GAUGES,
+    "count": COUNTERS,
+    "histogram": HISTOGRAMS,
+    "event": EVENTS,
+}
+
+ALL = GAUGES | COUNTERS | HISTOGRAMS | EVENTS

@@ -1,11 +1,19 @@
 """Process-local telemetry recorder.
 
 One :class:`Telemetry` instance per worker (executor thread, pod process, or
-the driver itself). The hot path — ``span`` enter/exit, ``gauge`` — touches
-only a ``deque.append`` and a dict store, both single GIL-atomic operations,
-so per-worker recording is lock-free; the only lock in the class guards the
-RPC latency accumulators, which sit on network-bound paths where a ~100ns
-uncontended acquire is noise.
+the driver itself). The hot path — ``span`` enter/exit, ``gauge``,
+``event``, ``histogram`` — touches only ``deque.append``s and dict stores,
+each a single GIL-atomic operation, so per-worker recording is lock-free;
+the only lock in the class guards the RPC latency accumulators, which sit
+on network-bound paths where a ~100ns uncontended acquire is noise.
+
+Every record is tagged with the thread-ambient trace id
+(:mod:`maggy_tpu.telemetry.tracing`) when one is in scope, and teed into a
+bounded flight ring the stall watchdog
+(:mod:`maggy_tpu.telemetry.flightrec`) dumps when a progress loop wedges.
+``histogram`` aggregates latency samples into fixed-log-bucket
+distributions (:mod:`maggy_tpu.telemetry.histogram`) that ride in
+snapshots — mergeable across workers, percentile-ready.
 
 Two clocks, deliberately: every record carries a wall-clock ``ts``
 (``time.time()``, the common base that lets the exporter merge spans from
@@ -24,8 +32,12 @@ import contextlib
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
+
+from maggy_tpu.telemetry import tracing
+from maggy_tpu.telemetry.histogram import LatencyHistogram
 
 ENV_FLAG = "MAGGY_TPU_TELEMETRY"
 
@@ -33,6 +45,10 @@ ENV_FLAG = "MAGGY_TPU_TELEMETRY"
 # (a worker with an attached sink flushes every heartbeat, so the cap only
 # matters for unflushed standalone use)
 DEFAULT_CAPACITY = 100_000
+
+# flight-recorder ring: the last records this worker produced, always in
+# memory, dumped by the stall watchdog (telemetry/flightrec.py)
+FLIGHT_CAPACITY = 512
 
 
 def enabled() -> bool:
@@ -49,8 +65,15 @@ class Telemetry:
         self.worker = str(worker)
         self.role = role
         self._events: deque = deque(maxlen=capacity)
+        # bounded tee of the same records for the stall flight recorder —
+        # never drained, so a dump always has the recent past
+        self.flight: deque = deque(maxlen=FLIGHT_CAPACITY)
         self._gauges: Dict[str, float] = {}
         self._counters: Dict[str, int] = {}
+        # name -> fixed-log-bucket latency distribution (single-writer per
+        # worker, like counters; snapshot copies under no lock by the same
+        # GIL-atomicity argument)
+        self._hists: Dict[str, LatencyHistogram] = {}
         # verb -> [n, total_ms, max_ms]; the single locked structure (see
         # module docstring) because two threads (worker + heartbeat) write it
         self._rpc: Dict[str, List[float]] = {}
@@ -59,8 +82,19 @@ class Telemetry:
         # flush is called from both the worker thread (trial boundaries) and
         # the heartbeat thread (per beat); serialize so JSONL lines never tear
         self._flush_lock = threading.Lock()
+        _instances.add(self)
 
     # ------------------------------------------------------------------ spans
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        """Journal one record (sink buffer + flight ring), tagging it with
+        the thread-ambient trace id when one is in scope — the whole
+        cross-worker correlation story is this one optional field."""
+        trace = tracing.current()
+        if trace is not None:
+            rec["trace"] = trace
+        self._events.append(rec)
+        self.flight.append(rec)
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs) -> Iterator[None]:
@@ -80,7 +114,7 @@ class Telemetry:
             }
             if attrs:
                 rec["attrs"] = attrs
-            self._events.append(rec)
+            self._append(rec)
 
     # ------------------------------------------------------- gauges / counters
 
@@ -88,7 +122,7 @@ class Telemetry:
         """Set a gauge to its latest value (also journaled as an event)."""
         value = float(value)
         self._gauges[name] = value
-        self._events.append(
+        self._append(
             {
                 "kind": "gauge",
                 "name": name,
@@ -101,6 +135,32 @@ class Telemetry:
     def count(self, name: str, n: int = 1) -> None:
         """Increment a counter (single-writer per worker by design)."""
         self._counters[name] = self._counters.get(name, 0) + n
+
+    def event(self, name: str, trace: Optional[str] = None, **attrs) -> None:
+        """Journal one lifecycle milestone (request/run state transition),
+        correlated by ``trace`` (explicit, else the thread-ambient id)."""
+        rec: Dict[str, Any] = {
+            "kind": "event",
+            "name": name,
+            "ts": time.time(),
+            "worker": self.worker,
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        if trace is not None:
+            rec["trace"] = trace
+            self._events.append(rec)
+            self.flight.append(rec)
+        else:
+            self._append(rec)
+
+    def histogram(self, name: str, value_ms: float) -> None:
+        """Observe one latency sample into the named fixed-log-bucket
+        histogram (created on first use; serialized into snapshots)."""
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists.setdefault(name, LatencyHistogram())
+        h.observe(value_ms)
 
     def rpc(self, verb: str, ms: Optional[float] = None, ok: bool = True) -> None:
         """Record one RPC round-trip for ``verb`` (thread-safe)."""
@@ -126,6 +186,8 @@ class Telemetry:
             out["gauges"] = dict(self._gauges)
         if self._counters:
             out["counters"] = dict(self._counters)
+        if self._hists:
+            out["hist"] = {name: h.to_dict() for name, h in self._hists.items()}
         with self._rpc_lock:
             if self._rpc:
                 out["rpc"] = {
@@ -196,6 +258,12 @@ class NullTelemetry:
     def count(self, name: str, n: int = 1) -> None:
         pass
 
+    def event(self, name: str, trace: Optional[str] = None, **attrs) -> None:
+        pass
+
+    def histogram(self, name: str, value_ms: float) -> None:
+        pass
+
     def rpc(self, verb: str, ms: Optional[float] = None, ok: bool = True) -> None:
         pass
 
@@ -216,6 +284,22 @@ class NullTelemetry:
 
 
 NULL = NullTelemetry()
+
+# every live recorder, for the stall watchdog's dump (weak: a recorder dies
+# with its owner, the registry must not keep it alive)
+_instances: "weakref.WeakSet[Telemetry]" = weakref.WeakSet()
+
+
+def flight_snapshots() -> List[Dict[str, Any]]:
+    """Every live recorder's flight ring (most recent records last), for
+    the watchdog dump. Rings are copied, never drained."""
+    out = []
+    for tel in list(_instances):
+        ring = list(tel.flight)
+        if ring:
+            out.append({"worker": tel.worker, "role": tel.role, "events": ring})
+    return out
+
 
 # thread-ambient recorder: executors are THREADS in one process (like the
 # Reporter print tee), so the current recorder is thread-local, with one lazy

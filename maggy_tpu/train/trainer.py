@@ -793,6 +793,8 @@ class Trainer:
         from maggy_tpu import telemetry
         from maggy_tpu.resilience import chaos as _chaos
         from maggy_tpu.resilience import preemption as _preemption
+        from maggy_tpu.telemetry import flightrec as _flightrec
+        from maggy_tpu.telemetry import tracing as _tracing
 
         tel = telemetry.get()
         resumed_from = None
@@ -857,8 +859,25 @@ class Trainer:
         fit_t0 = time.perf_counter()
         tokens_per_batch = 0
         step_ms_sum = 0.0
+        # one trace per fit run: every span/gauge the loop records carries
+        # it, and the run's start/end land as lifecycle events — the
+        # training-side analogue of a serving request's lane
+        run_trace = _tracing.new_trace_id()
+        trace_prev = _tracing.current()
+        _tracing.set_current(run_trace)
+        tel.event(
+            "train.run_start", trace=run_trace, num_steps=num_steps,
+            resumed_from=resumed_from, step0=step0,
+        )
+        # stall watchdog: the loop beats per step; a wedged device/step
+        # dumps the flight recorder (docs/observability.md). The threshold
+        # is far above any healthy step — a long first-step compile only
+        # risks a harmless diagnostic dump.
+        wd = _flightrec.get()
+        wd.begin("train.step", detail=step0)
         try:
             for i in range(num_steps):  # hot-loop (tools/check_host_sync.py)
+                wd.beat("train.step", detail=step0 + i)
                 if chaos is not None:
                     # deterministic fault injection (chaos harness): a
                     # matching kill rule raises WorkerLost here
@@ -921,7 +940,14 @@ class Trainer:
                         j, lagged = src
                         last_bcast = j
                         tel.gauge("metrics_lag", i - j)
+                        t_drain = time.perf_counter()
                         value = metric_sign * float(lagged[metric_key])  # sync: ok — ref aged out of the window
+                        # host time blocked in this read: the per-step
+                        # drain cost analyze_trace attributes
+                        tel.gauge(
+                            "metrics_drain_ms",
+                            (time.perf_counter() - t_drain) * 1e3,
+                        )
                         reporter.broadcast(value, step=step0 + j + 1)
                 if checkpointer is not None and checkpoint_every and (
                     (i + 1) % checkpoint_every == 0
@@ -930,10 +956,16 @@ class Trainer:
                         step0 + i + 1, state, meta=self.checkpoint_meta()
                     )
         finally:
+            wd.end("train.step")
+            _tracing.set_current(trace_prev)
             if prefetcher is not None:
                 prefetcher.close()
             if profiling:  # loop ended/raised while a trace was active
                 jax.profiler.stop_trace()
+        tel.event(
+            "train.run_end", trace=run_trace, steps=num_steps,
+            preempted=preempted,
+        )
         out = {k: float(v) for k, v in metrics.items()}
         if resumed_from is not None:
             out["resumed_from"] = float(resumed_from)

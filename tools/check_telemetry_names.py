@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Lint: every telemetry metric name must be in the checked-in registry.
+
+A typo'd metric name (``tel.gauge("serve.ttft_m", ...)``) never errors — it
+silently mints a second series, and every consumer keyed on the real name
+(monitor panel, SSTATS percentile, analyze_trace attribution) quietly loses
+data. This lint makes the name set closed:
+
+* ``maggy_tpu/telemetry/metrics.py`` is the registry — per-kind frozensets
+  (``GAUGES``/``COUNTERS``/``HISTOGRAMS``/``EVENTS``) plus
+  ``DYNAMIC_PREFIXES`` for the few f-string names whose tail is a bounded
+  runtime enum (request terminal states, RPC verbs).
+* This tool AST-walks ``maggy_tpu/`` for ``.gauge(`` / ``.count(`` /
+  ``.histogram(`` / ``.event(`` calls on telemetry-ish receivers (any name
+  in the receiver chain containing ``tel`` — ``tel``, ``telemetry``,
+  ``self.telemetry``, ``telemetry.get()`` — so ``str.count`` is never
+  flagged) and checks:
+  - a literal string name must be in the registry set for its kind;
+  - an f-string name's leading literal must match a dynamic prefix;
+  - anything else (a plain variable) is skipped — it cannot be checked
+    statically, and the codebase passes literals everywhere that matters.
+
+Usage: ``python tools/check_telemetry_names.py [root]`` — exits nonzero
+listing violations. Wired into the tier-1 run via ``tests/test_tracing.py``,
+beside the host-sync, exception-hygiene, bare-print, and docs-nav lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import sys
+from typing import List, Tuple
+
+TELEMETRY_METHODS = ("gauge", "count", "histogram", "event")
+
+
+def load_registry(repo: str):
+    """Load metrics.py by path (no package import — it must stay stdlib-only)."""
+    path = os.path.join(repo, "maggy_tpu", "telemetry", "metrics.py")
+    spec = importlib.util.spec_from_file_location("maggy_tpu_metrics_registry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _receiver_is_telemetry(expr: ast.AST) -> bool:
+    """True when the call receiver plausibly is a telemetry recorder: some
+    identifier in its chain contains 'tel'. Keeps ``"abc".count("a")`` and
+    ``mylist.count(x)`` out of scope."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and "tel" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and "tel" in node.attr.lower():
+            return True
+    return False
+
+
+def check_source(source: str, path: str, registry) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    tree = ast.parse(source, filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) or fn.attr not in TELEMETRY_METHODS:
+            continue
+        if not _receiver_is_telemetry(fn.value):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        allowed = registry.BY_KIND[fn.attr]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+            if name not in allowed:
+                hint = (
+                    " (registered under another kind)"
+                    if name in registry.ALL
+                    else ""
+                )
+                out.append(
+                    (
+                        node.lineno,
+                        f"{fn.attr}({name!r}) not in the metric registry"
+                        f"{hint} — add it to telemetry/metrics.py or fix the typo",
+                    )
+                )
+        elif isinstance(arg, ast.JoinedStr):
+            lead = ""
+            if arg.values and isinstance(arg.values[0], ast.Constant):
+                lead = str(arg.values[0].value)
+            if not any(lead.startswith(p) for p in registry.DYNAMIC_PREFIXES):
+                out.append(
+                    (
+                        node.lineno,
+                        f"{fn.attr}(f\"{lead}...\") has no registered dynamic "
+                        "prefix — add one to DYNAMIC_PREFIXES or use a literal",
+                    )
+                )
+        # plain variables: statically uncheckable, skipped
+    return out
+
+
+def check_tree(root: str, registry) -> List[Tuple[str, int, str]]:
+    violations: List[Tuple[str, int, str]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))
+        ]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            try:
+                hits = check_source(source, path, registry)
+            except SyntaxError as e:
+                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
+                continue
+            violations.extend((path, line, what) for line, what in hits)
+    return violations
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = args[0] if args else os.path.join(repo, "maggy_tpu")
+    registry = load_registry(repo)
+    violations = check_tree(root, registry)
+    for path, line, what in violations:
+        print(f"{path}:{line}: {what}", file=sys.stderr)
+    if violations:
+        print(f"{len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
