@@ -1,0 +1,73 @@
+"""Autopilot: profile-guided continuous tuning (the ASAP direction).
+
+The startup autotuner (:mod:`maggy_tpu.tune`) picks a system config once,
+before anything runs. This package closes the loop *while the job runs*:
+
+* :mod:`~maggy_tpu.autopilot.diagnose` — classify the dominant bottleneck
+  per telemetry window (input/compute/drain/queue/memory), with an
+  evidence struct naming the metrics behind every verdict; consumes the
+  same attribution code path as ``tools/analyze_trace.py``.
+* :mod:`~maggy_tpu.autopilot.knobs` — the checked-in knob registry
+  (type/bounds/safe-live per knob; ``tools/check_knob_registry.py``
+  enforces it in tier-1).
+* :mod:`~maggy_tpu.autopilot.plan` — diagnosis → candidate moves over the
+  registry, AOT-feasibility-pruned, persisted per workload fingerprint so
+  a fleet shares learned configs.
+* :mod:`~maggy_tpu.autopilot.controller` — the online controller: guarded
+  before/after windows around every live re-tune, automatic rollback on
+  guard regression, every decision journaled as ``autopilot.*`` telemetry.
+
+Wiring: ``Trainer.fit(autopilot=...)``, ``Scheduler(autopilot=...)``,
+``Router(autopilot=...)``. See docs/autotune.md "Continuous tuning".
+"""
+
+from __future__ import annotations
+
+from maggy_tpu.autopilot.controller import (  # noqa: F401
+    AutopilotConfig,
+    Controller,
+    RouterTarget,
+    SchedulerTarget,
+)
+from maggy_tpu.autopilot.diagnose import (  # noqa: F401
+    BOTTLENECKS,
+    Diagnosis,
+    Thresholds,
+    diagnose_records,
+    diagnose_requests,
+    diagnose_serve,
+    diagnose_steps,
+    diagnose_train,
+)
+from maggy_tpu.autopilot.knobs import KNOBS, Knob  # noqa: F401
+from maggy_tpu.autopilot.plan import (  # noqa: F401
+    DecisionStore,
+    Move,
+    Planner,
+    aot_memory_check,
+    traffic_shape,
+    workload_fingerprint,
+)
+
+__all__ = [
+    "AutopilotConfig",
+    "Controller",
+    "SchedulerTarget",
+    "RouterTarget",
+    "BOTTLENECKS",
+    "Diagnosis",
+    "Thresholds",
+    "diagnose_train",
+    "diagnose_serve",
+    "diagnose_steps",
+    "diagnose_requests",
+    "diagnose_records",
+    "KNOBS",
+    "Knob",
+    "Move",
+    "Planner",
+    "DecisionStore",
+    "aot_memory_check",
+    "traffic_shape",
+    "workload_fingerprint",
+]

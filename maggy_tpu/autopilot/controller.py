@@ -1,0 +1,420 @@
+"""Online controller: the loop that closes telemetry→config while running.
+
+A :class:`Controller` is a deterministic state machine fed metric samples
+(``observe``) by whatever loop hosts it — ``Trainer.fit`` pushes one sample
+per step, the serve ``Scheduler`` and fleet ``Router`` sample their own
+stats on a wall-clock cadence (``maybe_sample``). Samples aggregate into
+fixed-size windows; each completed window drives one transition:
+
+* **baseline** — diagnose the window (:mod:`maggy_tpu.autopilot.diagnose`),
+  plan a safe-live move (:mod:`maggy_tpu.autopilot.plan`), apply it through
+  the target, remember the window's guard score, enter **trial**.
+* **trial** — the next full window measures the move. Guard metric at or
+  above ``before * (1 - regress_tol)`` commits the move (and records it in
+  the workload-fingerprint decision cache so the fleet shares it); below,
+  the controller **rolls back automatically** to the previous value.
+  Samples taken while the target is still applying a move (e.g. the serve
+  drain-and-reconfigure) are discarded, so a trial window never bills the
+  transition cost to the new config.
+
+Every transition is journaled as ``autopilot.*`` telemetry
+(``diagnosis``/``applied``/``committed``/``rollback`` events plus
+``autopilot.retunes``/``autopilot.rollbacks`` counters and the
+``autopilot.tick_ms`` overhead gauge), so ``/monitor`` and
+``tools/analyze_trace.py`` can show what the autopilot did and why.
+
+A **target** is any object with::
+
+    scope: "train" | "serve"          # picks the diagnoser
+    guard_metric: str                 # sample key; higher is better
+    current() -> {knob name: value}   # registered knobs it owns
+    apply(knob, value) -> bool        # enact one move (False: refused)
+    pending() -> bool                 # still mid-apply (optional)
+    sample() -> {metric: value}       # pull-mode only (maybe_sample)
+
+:class:`SchedulerTarget` and :class:`RouterTarget` adapt the serving tiers;
+``Trainer.fit`` builds its own in-loop target.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from maggy_tpu import telemetry
+from maggy_tpu.autopilot import diagnose as diag_mod
+from maggy_tpu.autopilot.plan import DecisionStore, Move, Planner
+
+
+@dataclasses.dataclass
+class AutopilotConfig:
+    """Cadence and guard knobs for one controller."""
+
+    window: int = 16  # samples per measurement window
+    cooldown_windows: int = 1  # quiet windows after each decision
+    regress_tol: float = 0.05  # rollback when after < before * (1 - tol)
+    interval_s: float = 0.25  # pull-mode sampling cadence
+    live_only: bool = True  # online controller: safe-live moves only
+    store: bool = True  # persist decisions to the tune cache
+    thresholds: diag_mod.Thresholds = dataclasses.field(
+        default_factory=diag_mod.Thresholds
+    )
+
+    def validate(self) -> None:
+        if self.window < 2:
+            raise ValueError("autopilot window must be >= 2 samples")
+        if not 0.0 <= self.regress_tol < 1.0:
+            raise ValueError("regress_tol must be in [0, 1)")
+
+
+class Controller:
+    """One target's guarded continuous-tuning loop (see module docstring)."""
+
+    def __init__(
+        self,
+        target: Any,
+        config: Optional[AutopilotConfig] = None,
+        planner: Optional[Planner] = None,
+        telemetry_recorder=None,
+        store: Optional[DecisionStore] = None,
+        workload: Optional[str] = None,
+    ):
+        self.target = target
+        self.config = config or AutopilotConfig()
+        self.config.validate()
+        self.planner = planner or Planner()
+        self.telemetry = telemetry_recorder or telemetry.get()
+        self.workload = workload
+        self._store = store
+        if store is None and self.config.store and workload is not None:
+            try:
+                self._store = DecisionStore()
+            except Exception:  # noqa: BLE001 - no env root: run cache-less
+                self._store = None
+        self._samples: List[Dict[str, Any]] = []
+        self._phase = "baseline"
+        self._cooldown = 0
+        self._move: Optional[Move] = None
+        self._prev_value: Any = None
+        self._baseline_score: float = 0.0
+        self._last_score: Optional[float] = None  # newest full-window guard
+        self._last_sample_ts = 0.0
+        self.diagnoses = 0
+        self.retunes = 0
+        self.rollbacks = 0
+        # last decision, for STATUS/monitor panels
+        self.last: Dict[str, Any] = {"phase": self._phase}
+        self._seed_from_store()
+
+    # ----------------------------------------------------------- fleet seed
+
+    def _seed_from_store(self) -> None:
+        """Apply knobs a fleet peer already committed for this workload."""
+        if self._store is None or self.workload is None:
+            return
+        current = self.target.current()
+        for knob, value in self._store.load(self.workload).items():
+            if knob not in current or current[knob] == value:
+                continue
+            try:
+                move = Move(knob, value, reason="decision cache")
+            except ValueError:
+                continue  # stale record naming a since-removed knob
+            if not move.spec.safe_live or not move.spec.valid(value):
+                continue
+            if self.target.apply(knob, value):
+                self.telemetry.event(
+                    "autopilot.applied", knob=knob, value=value,
+                    prev=current[knob], reason="decision cache",
+                    workload=self.workload,
+                )
+
+    # ------------------------------------------------------------- sampling
+
+    def maybe_sample(self, now: Optional[float] = None) -> None:
+        """Pull-mode tick (serve loops call this every iteration): at most
+        one ``target.sample()`` per ``interval_s``."""
+        now = time.time() if now is None else now
+        if now - self._last_sample_ts < self.config.interval_s:
+            return
+        self._last_sample_ts = now
+        self.observe(self.target.sample())
+
+    def observe(self, sample: Dict[str, Any]) -> None:
+        """Push one metric sample; closes a window every ``window`` calls.
+        Samples during a target's pending apply are discarded (the trial
+        window must measure the new config, not the transition)."""
+        t0 = time.perf_counter()
+        pending = getattr(self.target, "pending", None)
+        if pending is not None and pending():
+            self._samples.clear()
+            return
+        self._samples.append(sample)
+        if len(self._samples) >= self.config.window:
+            self._close_window()
+        self.telemetry.gauge(
+            "autopilot.tick_ms", (time.perf_counter() - t0) * 1e3
+        )
+
+    # -------------------------------------------------------------- windows
+
+    @staticmethod
+    def _aggregate(samples: List[Dict[str, Any]]) -> Dict[str, float]:
+        """Mean of every numeric key across the window (None-safe)."""
+        sums: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        for s in samples:
+            for k, v in s.items():
+                if v is None:
+                    continue
+                try:
+                    f = float(v)
+                except (TypeError, ValueError):
+                    continue
+                sums[k] = sums.get(k, 0.0) + f
+                counts[k] = counts.get(k, 0) + 1
+        return {k: sums[k] / counts[k] for k in sums}
+
+    def _close_window(self) -> None:
+        window = self._aggregate(self._samples)
+        self._samples.clear()
+        score = float(window.get(self.target.guard_metric) or 0.0)
+        self._last_score = score
+        if self._phase == "trial":
+            self._close_trial(score)
+            return
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return
+        diagnosis = (
+            diag_mod.diagnose_serve(window, self.config.thresholds)
+            if self.target.scope == "serve"
+            else diag_mod.diagnose_train(window, self.config.thresholds)
+        )
+        self.diagnoses += 1
+        self.telemetry.count("autopilot.diagnoses")
+        self.telemetry.event(
+            "autopilot.diagnosis",
+            bottleneck=diagnosis.bottleneck,
+            scope=diagnosis.scope,
+            evidence=diagnosis.evidence,
+            shares=diagnosis.to_dict()["shares"],
+            reason=diagnosis.reason,
+        )
+        self.last.update(
+            {"phase": self._phase, "bottleneck": diagnosis.bottleneck}
+        )
+        moves = self.planner.plan(
+            diagnosis, self.target.current(), live_only=self.config.live_only
+        )
+        if moves:
+            self._start_trial(moves[0], score)
+
+    def _start_trial(self, move: Move, baseline_score: float) -> None:
+        prev = self.target.current().get(move.knob)
+        if not self.target.apply(move.knob, move.value):
+            return
+        self._move = move
+        self._prev_value = prev
+        self._baseline_score = baseline_score
+        self._phase = "trial"
+        self.telemetry.event(
+            "autopilot.applied",
+            knob=move.knob, value=move.value, prev=prev,
+            reason=move.reason, guard_before=baseline_score,
+            workload=self.workload,
+        )
+        self.last.update(
+            {
+                "phase": "trial",
+                "move": f"{move.knob}={move.value}",
+                "prev": prev,
+            }
+        )
+
+    def inject(self, move: Move) -> bool:
+        """Chaos/test seam: force a trial of ``move`` right now, bypassing
+        diagnosis — the guard + rollback machinery still judges it against
+        the current (possibly partial) window's score."""
+        if self._phase == "trial":
+            return False
+        # best available baseline: the partial window if it has guard
+        # samples, else the newest completed window's score — an injected
+        # move must still be judged against a REAL before-measurement
+        partial = self._aggregate(self._samples).get(self.target.guard_metric)
+        score = float(
+            partial
+            if partial is not None
+            else (self._last_score if self._last_score is not None else 0.0)
+        )
+        self._samples.clear()
+        self._start_trial(move, score)
+        return self._phase == "trial"
+
+    def _close_trial(self, score: float) -> None:
+        move, prev = self._move, self._prev_value
+        before = self._baseline_score
+        kept = score >= before * (1.0 - self.config.regress_tol)
+        if kept:
+            self.retunes += 1
+            self.telemetry.count("autopilot.retunes")
+            self.telemetry.event(
+                "autopilot.committed",
+                knob=move.knob, value=move.value,
+                guard_before=before, guard_after=score,
+                workload=self.workload,
+            )
+            outcome = "committed"
+            self.last.update({"phase": "baseline", "move": f"{move.knob}={move.value}"})
+        else:
+            self.target.apply(move.knob, prev)
+            self.rollbacks += 1
+            self.telemetry.count("autopilot.rollbacks")
+            self.telemetry.event(
+                "autopilot.rollback",
+                knob=move.knob, value=move.value, restored=prev,
+                guard_before=before, guard_after=score,
+                workload=self.workload,
+            )
+            outcome = "rolled_back"
+            self.last.update(
+                {"phase": "baseline", "move": f"{move.knob}={prev} (rollback)"}
+            )
+        if self._store is not None and self.workload is not None:
+            self._store.record(
+                self.workload, move, outcome=outcome,
+                before=before, after=score,
+            )
+        self._move = None
+        self._prev_value = None
+        self._phase = "baseline"
+        self._cooldown = self.config.cooldown_windows
+
+    # --------------------------------------------------------------- status
+
+    def status(self) -> Dict[str, Any]:
+        """Panel-ready summary (monitor serve/fleet dashboards)."""
+        return {
+            "phase": self._phase,
+            "bottleneck": self.last.get("bottleneck"),
+            "last_move": self.last.get("move"),
+            "diagnoses": self.diagnoses,
+            "retunes": self.retunes,
+            "rollbacks": self.rollbacks,
+            "workload": self.workload,
+        }
+
+
+# ------------------------------------------------------------------ targets
+
+
+class SchedulerTarget:
+    """Adapts a serve :class:`~maggy_tpu.serve.scheduler.Scheduler`:
+    samples window token rates from its stats snapshot; applies queue and
+    slot-geometry knobs (slot changes go through the scheduler's
+    drain-and-reconfigure seam and report ``pending`` until enacted)."""
+
+    scope = "serve"
+    guard_metric = "tokens_per_sec"
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._last_tokens: Optional[int] = None
+        self._last_ts = 0.0
+
+    def sample(self) -> Dict[str, Any]:
+        s = self.scheduler.stats()
+        now = time.time()
+        # window-delta token rate: more honest than the loop EMA for a
+        # guard, because it covers exactly the sampled interval
+        rate = None
+        if self._last_tokens is not None and now > self._last_ts:
+            rate = (s["tokens_out"] - self._last_tokens) / (now - self._last_ts)
+        self._last_tokens, self._last_ts = s["tokens_out"], now
+        engine = self.scheduler.engine
+        return {
+            "queue_depth": s["queue_depth"],
+            "active_slots": s["active_slots"],
+            "num_slots": s["num_slots"],
+            "tpot_ms_p50": s.get("tpot_ms_p50"),
+            "drain_ms": getattr(engine, "last_drain_ms", 0.0),
+            "tokens_per_sec": rate,
+        }
+
+    def current(self) -> Dict[str, Any]:
+        engine = self.scheduler.engine
+        return {
+            "serve.num_slots": engine.slots.num_slots,
+            "serve.max_queue": self.scheduler.max_queue,
+            "serve.async_decode": engine.async_decode,
+            "serve.prefix_min": engine.prefix_min,
+        }
+
+    def pending(self) -> bool:
+        return self.scheduler.reconfigure_pending()
+
+    def apply(self, knob: str, value: Any) -> bool:
+        if knob == "serve.num_slots":
+            return self.scheduler.request_reconfigure(int(value))
+        if knob == "serve.max_queue":
+            self.scheduler.max_queue = int(value)
+            return True
+        if knob == "serve.async_decode":
+            engine = self.scheduler.engine
+            engine.flush()  # no stale double-buffer across the flip
+            engine.async_decode = bool(value)
+            return True
+        if knob == "serve.prefix_min":
+            engine = self.scheduler.engine
+            engine.prefix_min = max(1, int(value))
+            engine.prefix_index.min_len = engine.prefix_min
+            return True
+        return False
+
+
+class RouterTarget:
+    """Adapts the fleet :class:`~maggy_tpu.serve.fleet.router.Router`:
+    guard is fleet SLO attainment; moves touch the admission policy and
+    the TTFT budget (both instant, lock-guarded config fields)."""
+
+    scope = "serve"
+    guard_metric = "slo_attainment"
+
+    def __init__(self, router):
+        self.router = router
+
+    def sample(self) -> Dict[str, Any]:
+        with self.router._lock:
+            s = self.router._fleet_stats()
+        return {
+            "queue_depth": s["queue_depth"],
+            "active_slots": s["active_slots"],
+            "num_slots": s["num_slots"],
+            "tpot_ms_p50": s.get("tpot_ms_p50"),
+            "drain_ms": 0.0,
+            "slo_attainment": s.get("slo_attainment"),
+        }
+
+    def current(self) -> Dict[str, Any]:
+        cfg = self.router.config
+        return {
+            "fleet.admission": cfg.admission,
+            "fleet.slo_ttft_ms": cfg.slo_ttft_ms,
+        }
+
+    def pending(self) -> bool:
+        return False
+
+    def apply(self, knob: str, value: Any) -> bool:
+        cfg = self.router.config
+        with self.router._lock:
+            if knob == "fleet.admission":
+                if value not in ("queue", "shed"):
+                    return False
+                cfg.admission = str(value)
+                return True
+            if knob == "fleet.slo_ttft_ms":
+                cfg.slo_ttft_ms = float(value)
+                return True
+        return False

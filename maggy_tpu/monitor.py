@@ -183,6 +183,14 @@ def _telemetry_lines(status: dict, width: int) -> list:
             )
         if "checkpoint_fallback" in c:
             parts.append(f"ckpt-fallback {c['checkpoint_fallback']}")
+        # autopilot (maggy_tpu/autopilot): the telemetry→config loop's
+        # scoreboard — windows diagnosed, guarded re-tunes kept, rollbacks
+        if "autopilot.diagnoses" in c:
+            parts.append(
+                f"autopilot diag={c['autopilot.diagnoses']}"
+                f" retune={c.get('autopilot.retunes', 0)}"
+                f" rb={c.get('autopilot.rollbacks', 0)}"
+            )
         if "flightrec.dumps" in c:
             # a stall dump is a red flag worth surfacing on the panel
             parts.append(f"STALL-DUMPS {c['flightrec.dumps']}")
@@ -212,6 +220,24 @@ def _latency_parts(sv: dict) -> list:
             f" ({sv.get('slo_ok', 0)}/{sv.get('slo_ok', 0) + sv.get('slo_miss', 0)})"
         )
     return parts
+
+
+def _autopilot_line(sv: dict) -> list:
+    """One panel line for the serve/fleet autopilot status the scheduler/
+    router folds into SSTATS: last verdict, last guarded move, and the
+    commit/rollback scoreboard (docs/autotune.md "Continuous tuning")."""
+    ap = sv.get("autopilot")
+    if not ap:
+        return []
+    parts = [f"autopilot[{ap.get('phase', '?')}]"]
+    if ap.get("bottleneck"):
+        parts.append(ap["bottleneck"])
+    if ap.get("last_move"):
+        parts.append(f"-> {ap['last_move']}")
+    parts.append(
+        f"(retunes {ap.get('retunes', 0)}, rollbacks {ap.get('rollbacks', 0)})"
+    )
+    return [" ".join(parts)]
 
 
 def _wrap_parts(parts: list, width: int) -> list:
@@ -309,6 +335,7 @@ def render_status(status: dict, width: int = 78) -> str:
             )
         agg.extend(_latency_parts(sv))
         lines.extend(_wrap_parts(agg, width))
+        lines.extend(line[:width] for line in _autopilot_line(sv))
         for row in fleet.get("replicas") or []:
             bar = util.progress_bar(
                 row.get("active_slots", 0), max(row.get("num_slots", 1), 1),
@@ -352,6 +379,7 @@ def render_status(status: dict, width: int = 78) -> str:
         if compiles is not None:
             parts.append(f"decode compiles {compiles}")
         lines.extend(_wrap_parts(parts, width))
+        lines.extend(line[:width] for line in _autopilot_line(sv))
         lines.extend(_telemetry_lines(status, width))
     elif status.get("workers_done") is not None:
         lines.append(

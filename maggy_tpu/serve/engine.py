@@ -177,6 +177,9 @@ class Engine:
         # so a drain can discard rows whose slot churned in the meantime
         self._pending: Optional[Dict[str, Any]] = None
         self._zero_tokens = jnp.zeros((B,), jnp.int32)
+        # last async-drain host cost, sampled by the autopilot's serve
+        # diagnoser (the gauge of the same name feeds dashboards)
+        self.last_drain_ms = 0.0
 
         # trace-time side effects: these counters tick ONLY when jax retraces
         # the function, so they count compiles, not calls — the acceptance
@@ -471,6 +474,50 @@ class Engine:
             return None
         return src, shared
 
+    def reconfigure(self, num_slots: int) -> None:
+        """Drain-and-reconfigure seam: rebuild the slot geometry with
+        ``num_slots`` rows. Must be called with NO active slots (the
+        scheduler drains between waves first — docs/autotune.md "Continuous
+        tuning"); resident prefix anchors are dropped with the old cache.
+
+        The existing jit wrappers are kept — jax retraces them for the new
+        batch shape — and the decode step is warmed here with one
+        all-inactive dispatch, so the recompile is paid inside the
+        reconfigure (while the autopilot suppresses guard samples), not by
+        the first live request on the new geometry."""
+        num_slots = int(num_slots)
+        if num_slots < 1:
+            raise BadArgumentsError(f"num_slots must be >= 1, got {num_slots}")
+        if self.slots.active_count:
+            raise SlotOccupiedError(
+                f"reconfigure with {self.slots.active_count} active slot(s); "
+                "drain first"
+            )
+        self.flush()
+        if num_slots == self.slots.num_slots:
+            return
+        B = num_slots
+        self.slots = SlotManager(B)
+        self.prefix_index = PrefixIndex(min_len=self.prefix_min)
+        self.cache = init_cache(
+            self.decode_model, jnp.zeros((B, 1), jnp.int32), mesh=self.mesh
+        )
+        self.key_data = jnp.zeros((B, 2), jnp.uint32)
+        self._zero_tokens = jnp.zeros((B,), jnp.int32)
+        self._pending = None
+        # warm the decode compile at the new geometry (all rows masked)
+        zeros_i = jnp.zeros((B,), jnp.int32)
+        with self.telemetry.span("serve.reconfigure", num_slots=B), self._ctx():
+            self.cache, _, _, _ = jax.block_until_ready(
+                self._decode_jit(
+                    self.params, self.cache, self.key_data,
+                    zeros_i, zeros_i, jnp.zeros((B,), bool), zeros_i,
+                    jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32),
+                    zeros_i, zeros_i,
+                )
+            )
+        self._record_compile_gauges()
+
     def release(self, slot: int) -> Request:
         """Free a slot (EOS / max_new / cancel / deadline). Pure host-side:
         the decode step already zeroes inactive rows' cache index, and
@@ -586,6 +633,7 @@ class Engine:
         t0 = time.perf_counter()
         sampled = np.asarray(pending["sampled"])  # sync: ok — lagged double-buffer drain
         drain_ms = (time.perf_counter() - t0) * 1e3
+        self.last_drain_ms = drain_ms
         self.telemetry.gauge("serve.drain_ms", drain_ms)
         self.telemetry.histogram("serve.drain_ms", drain_ms)
         out: Dict[int, int] = {}

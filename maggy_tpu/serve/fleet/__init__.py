@@ -52,11 +52,14 @@ def launch_fleet(
     name: str = "maggy-fleet",
     host: str = "127.0.0.1",
     telemetry_recorder=None,
+    autopilot=None,
     **config_kwargs,
 ) -> Router:
     """Build a router over ``replicas`` fresh in-process replicas (device
     leases carved like trial sub-slices). Call ``router.start()`` to serve;
-    extra kwargs go to :class:`RouterConfig` (``slo_ttft_ms=...`` etc.)."""
+    extra kwargs go to :class:`RouterConfig` (``slo_ttft_ms=...`` etc.);
+    ``autopilot`` attaches an online controller to the router
+    (docs/autotune.md "Continuous tuning")."""
     if config is None:
         config = RouterConfig(**config_kwargs)
     elif config_kwargs:
@@ -71,5 +74,6 @@ def launch_fleet(
         secret=secret,
         name=name,
         telemetry_recorder=telemetry_recorder,
+        autopilot=autopilot,
     )
     return router

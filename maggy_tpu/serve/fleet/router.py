@@ -157,12 +157,33 @@ class Router:
         secret: Optional[str] = None,
         name: str = "maggy-fleet",
         telemetry_recorder=None,
+        autopilot=None,
     ):
         self.config = config or RouterConfig()
         self.config.validate()
         self.replicas = list(replicas)
         self.name = name
         self.telemetry = telemetry_recorder or telemetry.get()
+        # autopilot (docs/autotune.md): an online controller the pump
+        # thread ticks — admission/SLO knobs move under the fleet guard
+        self.autopilot = None
+        if autopilot is not None and autopilot is not False:
+            from maggy_tpu.autopilot import (
+                AutopilotConfig,
+                Controller,
+                RouterTarget,
+            )
+
+            cfg = autopilot if isinstance(autopilot, AutopilotConfig) else None
+            self.autopilot = (
+                autopilot
+                if isinstance(autopilot, Controller)
+                else Controller(
+                    RouterTarget(self),
+                    config=cfg,
+                    telemetry_recorder=self.telemetry,
+                )
+            )
         self._rpc = rpc.Server(num_executors=0, secret=secret)
         self._rpc.telemetry = self.telemetry
         self.quarantine = QuarantineTracker(
@@ -503,6 +524,8 @@ class Router:
                 if judged
                 else (ttft.attainment(self.config.slo_ttft_ms) if ttft else None)
             )
+        if self.autopilot is not None:
+            agg["autopilot"] = self.autopilot.status()
         return {
             **agg,
             "replicas": table,
@@ -583,6 +606,8 @@ class Router:
                 self._sweep_down_replicas()
                 self._dispatch_pending(time.time())
                 self._poll_routed()
+                if self.autopilot is not None:
+                    self.autopilot.maybe_sample(time.time())
             except Exception as e:  # noqa: BLE001 - pump must survive anything
                 self.log(f"pump error: {type(e).__name__}: {e}")
             self._stop.wait(self.config.pump_interval_s)

@@ -59,11 +59,34 @@ class Scheduler:
         telemetry_recorder=None,
         retention_s: float = RETENTION_S,
         slo_ttft_ms: Optional[float] = None,
+        autopilot=None,
     ):
         self.engine = engine
         self.max_queue = max_queue
         self.retention_s = retention_s
         self.telemetry = telemetry_recorder or engine.telemetry or telemetry.get()
+        # autopilot (docs/autotune.md "Continuous tuning"): an
+        # AutopilotConfig/True attaches an online controller the loop ticks;
+        # slot-geometry moves land through request_reconfigure below
+        self._pending_slots: Optional[int] = None
+        self.autopilot = None
+        if autopilot is not None and autopilot is not False:
+            from maggy_tpu.autopilot import (
+                AutopilotConfig,
+                Controller,
+                SchedulerTarget,
+            )
+
+            cfg = autopilot if isinstance(autopilot, AutopilotConfig) else None
+            self.autopilot = (
+                autopilot
+                if isinstance(autopilot, Controller)
+                else Controller(
+                    SchedulerTarget(self),
+                    config=cfg,
+                    telemetry_recorder=self.telemetry,
+                )
+            )
         self._lock = threading.RLock()
         self._wake = threading.Condition(self._lock)
         self._queue: deque = deque()  # FCFS: append right, pop left
@@ -155,6 +178,42 @@ class Scheduler:
             self._wake.notify_all()
             return True
 
+    def request_reconfigure(self, num_slots: int) -> bool:
+        """Ask for a new slot geometry (the autopilot's ``serve.num_slots``
+        safe-live move). Applied by the engine loop at the next wave
+        boundary: admission pauses, the active set drains naturally, the
+        engine rebuilds (compile warmed inside), then admission resumes —
+        queued requests wait, nothing is dropped."""
+        num_slots = int(num_slots)
+        if num_slots < 1:
+            return False
+        with self._wake:
+            if num_slots == self.engine.slots.num_slots:
+                self._pending_slots = None
+                return True
+            self._pending_slots = num_slots
+            self._wake.notify_all()
+        return True
+
+    def reconfigure_pending(self) -> bool:
+        """True while a requested slot-geometry change awaits the drain."""
+        return self._pending_slots is not None
+
+    def _maybe_reconfigure(self) -> None:
+        """Apply a pending slot change once the active set has drained
+        (loop thread only)."""
+        target = self._pending_slots
+        if target is None or self.engine.slots.active_count:
+            return
+        try:
+            self.engine.reconfigure(target)
+        except Exception as e:  # noqa: BLE001 - a failed re-tune must not kill serving
+            self.telemetry.event(
+                "autopilot.reconfigure_failed",
+                num_slots=target, error=f"{type(e).__name__}: {e}",
+            )
+        self._pending_slots = None
+
     def stats(self) -> Dict[str, Any]:
         """One consistent snapshot, built entirely under the scheduler lock.
 
@@ -205,6 +264,8 @@ class Scheduler:
             snap["slo_miss"] = miss
             snap["slo_attainment"] = ok / (ok + miss) if (ok + miss) else None
         snap.update({f"requests_{k}": v for k, v in counters.items()})
+        if self.autopilot is not None:
+            snap["autopilot"] = self.autopilot.status()
         return snap
 
     # -------------------------------------------------------------- lifecycle
@@ -287,6 +348,8 @@ class Scheduler:
 
     def _admit_ready(self, now: float) -> None:
         """Admit queued requests into free slots, FCFS; drop dead ones."""
+        if self._pending_slots is not None:
+            return  # drain-and-reconfigure in progress: let the wave empty
         while self.engine.slots.free_slots():
             with self._lock:
                 if not self._queue:
@@ -371,7 +434,10 @@ class Scheduler:
             wd.beat("serve.loop")
             now = time.time()
             self._sweep_active(now)
+            self._maybe_reconfigure()
             self._admit_ready(now)
+            if self.autopilot is not None:
+                self.autopilot.maybe_sample(now)
 
             active = self.engine.slots.active_slots()
             if active:

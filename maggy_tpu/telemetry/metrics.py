@@ -54,6 +54,8 @@ GAUGES = frozenset(
         "tune.candidates",
         "tune.pruned_oom",
         "tune.best_step_time",
+        # autopilot online controller (autopilot/controller.py)
+        "autopilot.tick_ms",  # per-sample controller cost (≤2% budget)
     }
 )
 
@@ -79,6 +81,10 @@ COUNTERS = frozenset(
         "tune.cache_hits",
         "tune.cache_misses",
         "flightrec.dumps",  # stall watchdog dumps written (telemetry/flightrec.py)
+        # autopilot online controller (autopilot/controller.py)
+        "autopilot.diagnoses",  # windows classified
+        "autopilot.retunes",  # guarded moves committed
+        "autopilot.rollbacks",  # guarded moves reverted on regression
     }
 )
 
@@ -111,6 +117,14 @@ EVENTS = frozenset(
         # training runs (train/trainer.py)
         "train.run_start",
         "train.run_end",
+        # autopilot decisions (autopilot/controller.py, serve/scheduler.py):
+        # the auditable telemetry→config loop — diagnosis verdicts, applied
+        # moves, guarded commits, automatic rollbacks
+        "autopilot.diagnosis",
+        "autopilot.applied",
+        "autopilot.committed",
+        "autopilot.rollback",
+        "autopilot.reconfigure_failed",
     }
 )
 

@@ -153,6 +153,23 @@ class DevicePrefetcher:
         self.consumed += 1
         return item
 
+    # -------------------------------------------------------------------- tune
+
+    def set_depth(self, depth: int) -> None:
+        """Live-retune the lookahead depth (the autopilot's
+        ``train.prefetch_depth`` safe-live knob). Growing takes effect
+        immediately — the producer's bounded put wakes and fills the larger
+        queue; shrinking applies lazily as the consumer drains below the
+        new bound (already-placed batches are never dropped)."""
+        depth = int(depth)
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.depth = depth
+        q = self._queue
+        with q.mutex:
+            q.maxsize = depth
+            q.not_full.notify_all()
+
     # -------------------------------------------------------------------- skip
 
     def skip(self, n: int) -> int:

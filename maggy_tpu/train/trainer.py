@@ -109,6 +109,40 @@ def _model_inputs(batch: Dict[str, jax.Array]) -> Tuple:
     raise KeyError("Batch must contain 'tokens' (LM) or 'inputs' (generic)")
 
 
+class _FitAutopilotTarget:
+    """In-loop knob holder for ``fit``'s autopilot controller (push-mode
+    target: the loop feeds per-step samples, and safe-live moves land on
+    the live prefetcher / metrics window immediately)."""
+
+    scope = "train"
+    guard_metric = "steps_per_sec"
+
+    def __init__(self, prefetcher, metrics_window: int):
+        self.prefetcher = prefetcher
+        self.metrics_window = int(metrics_window)
+
+    def sample(self):  # push-mode: the loop observes directly
+        return {}
+
+    def pending(self) -> bool:
+        return False
+
+    def current(self):
+        cur = {"train.metrics_window": self.metrics_window}
+        if self.prefetcher is not None:
+            cur["train.prefetch_depth"] = self.prefetcher.depth
+        return cur
+
+    def apply(self, knob, value) -> bool:
+        if knob == "train.prefetch_depth" and self.prefetcher is not None:
+            self.prefetcher.set_depth(int(value))
+            return True
+        if knob == "train.metrics_window":
+            self.metrics_window = max(0, int(value))
+            return True
+        return False
+
+
 @dataclasses.dataclass
 class Trainer:
     """Builds sharded state + compiled train/eval steps for a flax model."""
@@ -727,6 +761,7 @@ class Trainer:
         resume: Optional[Any] = None,
         prefetch: Optional[int] = None,
         metrics_window: int = 2,
+        autopilot: Optional[Any] = None,
     ) -> Tuple[TrainState, Dict[str, float]]:
         """Simple host-side loop: shard batch → step → optional reporter
         broadcast at step boundaries (where EarlyStopException can interrupt —
@@ -776,6 +811,15 @@ class Trainer:
         steps stale and driver-side early stopping fires up to that many
         steps later; ``metrics_window=0`` restores synchronous broadcasts.
         The ``metrics_lag`` gauge records the realized lag.
+
+        Autopilot (docs/autotune.md "Continuous tuning"): ``autopilot=True``
+        (or an :class:`~maggy_tpu.autopilot.AutopilotConfig`) attaches an
+        online controller that diagnoses each window of steps
+        (input/drain/compute-bound), live-retunes the safe knobs — prefetch
+        depth, metrics window — behind a measured before/after guard with
+        automatic rollback, journals every decision as ``autopilot.*``
+        telemetry, and shares committed knobs through the tune cache keyed
+        by workload fingerprint.
 
         Telemetry: each step records a ``train_step`` span plus
         ``step_time_ms`` / ``tokens_per_sec`` / ``mfu_est`` gauges into the
@@ -853,6 +897,21 @@ class Trainer:
                 telemetry_recorder=tel,
             )
         window = max(0, int(metrics_window))
+        # autopilot: an in-loop controller fed one sample per step; its
+        # safe-live moves land on the prefetcher depth / metrics window of
+        # THIS run (built lazily at step 0, once the batch signature that
+        # names the workload fingerprint is known)
+        ap = None
+        ap_target = None
+        ap_cfg = None
+        if autopilot is not None and autopilot is not False:
+            from maggy_tpu.autopilot import AutopilotConfig as _ApConfig
+
+            ap_cfg = (
+                autopilot if isinstance(autopilot, _ApConfig) else _ApConfig()
+            )
+            ap_target = _FitAutopilotTarget(prefetcher, window)
+        ap_wait_total = prefetcher.wait_ms_total if prefetcher is not None else 0.0
         pending: deque = deque()  # (loop index, in-flight device metrics)
         ready = None  # newest entry aged OUT of the window: safe to sync
         last_bcast = -1  # last loop index broadcast (monotonic step guard)
@@ -893,6 +952,8 @@ class Trainer:
                 if profile_dir is not None and not profiling and i == prof_start:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
+                ap_drain_ms = 0.0  # this step's measured broadcast drain
+                t_in0 = time.perf_counter() if ap_target is not None else 0.0
                 if prefetcher is not None:
                     # sharded batches arrive pre-placed; H2D transfer of this
                     # batch overlapped compute of the previous step
@@ -901,6 +962,14 @@ class Trainer:
                     batch = next(data_iter)
                     with tel.span("shard_batch", step=i):
                         sharded = self.shard_batch(batch)
+                if ap_target is not None:
+                    if prefetcher is not None:
+                        # queue-wait delta: the prefetcher already measures
+                        # exactly the blocked portion of this pull
+                        step_wait_ms = prefetcher.wait_ms_total - ap_wait_total
+                        ap_wait_total = prefetcher.wait_ms_total
+                    else:
+                        step_wait_ms = (time.perf_counter() - t_in0) * 1e3
                 if i == 0 and isinstance(sharded, dict) and "tokens" in sharded:
                     tokens_per_batch = int(  # sync: ok — shape metadata, not device data
                         getattr(sharded["tokens"], "size", 0)
@@ -944,10 +1013,8 @@ class Trainer:
                         value = metric_sign * float(lagged[metric_key])  # sync: ok — ref aged out of the window
                         # host time blocked in this read: the per-step
                         # drain cost analyze_trace attributes
-                        tel.gauge(
-                            "metrics_drain_ms",
-                            (time.perf_counter() - t_drain) * 1e3,
-                        )
+                        ap_drain_ms = (time.perf_counter() - t_drain) * 1e3
+                        tel.gauge("metrics_drain_ms", ap_drain_ms)
                         reporter.broadcast(value, step=step0 + j + 1)
                 if checkpointer is not None and checkpoint_every and (
                     (i + 1) % checkpoint_every == 0
@@ -955,6 +1022,46 @@ class Trainer:
                     checkpointer.save(
                         step0 + i + 1, state, meta=self.checkpoint_meta()
                     )
+                if ap_target is not None:
+                    if ap is None:
+                        # the first batch names the workload: (model config
+                        # + system config) x traffic shape -> the fleet-
+                        # shared decision-cache key
+                        from maggy_tpu.autopilot import (
+                            Controller as _ApController,
+                        )
+                        from maggy_tpu.autopilot import plan as _ap_plan
+
+                        bsz = seq = 0
+                        if isinstance(sharded, dict) and "tokens" in sharded:
+                            shape = getattr(sharded["tokens"], "shape", (0, 0))
+                            bsz, seq = int(shape[0]), int(shape[-1])  # sync: ok — shape metadata, not device data
+                        workload = _ap_plan.workload_fingerprint(
+                            repr(getattr(self.model, "cfg", type(self.model).__name__)),
+                            self.checkpoint_meta(),
+                            _ap_plan.traffic_shape("train", batch=bsz, seq=seq),
+                        )
+                        ap = _ApController(
+                            ap_target,
+                            config=ap_cfg,
+                            telemetry_recorder=tel,
+                            workload=workload,
+                        )
+                    elif i > 0:  # the compile step would poison the window
+                        # the guard is the TRUE per-step rate — compute plus
+                        # the input wait and broadcast drain a move targets
+                        wall_ms = dt_ms + step_wait_ms + ap_drain_ms
+                        ap.observe(
+                            {
+                                "step_time_ms": dt_ms,
+                                "input_wait_ms": step_wait_ms,
+                                "metrics_drain_ms": ap_drain_ms,
+                                "steps_per_sec": (
+                                    1e3 / wall_ms if wall_ms > 0 else 0.0
+                                ),
+                            }
+                        )
+                        window = max(0, ap_target.metrics_window)
         finally:
             wd.end("train.step")
             _tracing.set_current(trace_prev)

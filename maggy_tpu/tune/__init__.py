@@ -38,6 +38,7 @@ from maggy_tpu.tune import static as static_mod
 from maggy_tpu.tune.cache import (
     TuneCache,
     alias_cache_key,
+    alias_workload,
     cache_key,
     model_fingerprint,
     topology_key,
@@ -256,8 +257,14 @@ def tune(
         }
         cache.put(key, record)
         # grid-independent "latest winner" alias for consumers that never
-        # tuned themselves (serve --mesh auto)
-        cache.put(alias_cache_key(fingerprint, topology_key(devs), dtype), record)
+        # tuned themselves (serve --mesh auto) — scoped per workload
+        # fingerprint: the record is stamped so a read for a different
+        # (model, topology, dtype) can never resolve to this winner
+        topo = topology_key(devs)
+        cache.put(
+            alias_cache_key(fingerprint, topo, dtype),
+            {**record, "workload": alias_workload(fingerprint, topo, dtype)},
+        )
     return result
 
 
@@ -283,8 +290,14 @@ def cached_best(
     dtype = str(getattr(getattr(model, "cfg", None), "dtype", "na"))
     topo = topology_key(devs)
     if config is not None:
-        key = cache_key(fingerprint, topo, dtype, config.grid_fingerprint())
+        record = TuneCache(env).get(
+            cache_key(fingerprint, topo, dtype, config.grid_fingerprint())
+        )
     else:
-        key = alias_cache_key(fingerprint, topo, dtype)
-    record = TuneCache(env).get(key)
+        # workload-verified alias read: a clobbered/foreign record is a
+        # miss, never another workload's winner
+        record = TuneCache(env).get_alias(
+            alias_cache_key(fingerprint, topo, dtype),
+            alias_workload(fingerprint, topo, dtype),
+        )
     return TunedConfig.from_dict(record["best"]) if record else None

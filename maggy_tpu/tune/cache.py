@@ -52,6 +52,10 @@ def topology_key(devices: Optional[list] = None) -> Dict[str, Any]:
         "n_devices": len(devs),
         "platform": getattr(d0, "platform", "unknown"),
         "device_kind": getattr(d0, "device_kind", "unknown"),
+        # process layout is part of the topology: an 8-device single host
+        # and a 2x4 multi-process slice compile different programs, and two
+        # concurrent jobs with those shapes must not share cache records
+        "n_processes": jax.process_count(),
     }
 
 
@@ -68,16 +72,34 @@ def cache_key(
     return hashlib.sha256(payload.encode()).hexdigest()[:24]
 
 
-def alias_cache_key(fingerprint: str, topology: Dict[str, Any], dtype: str) -> str:
-    """Grid-independent pointer key: the LATEST winner for this (model,
-    topology, dtype) regardless of which grid found it. Consumers that never
-    tuned themselves (the serve CLI's ``--mesh auto``) look this up; exact
-    reproducibility consumers use the grid-bound :func:`cache_key`."""
+def alias_workload(
+    fingerprint: str, topology: Dict[str, Any], dtype: str
+) -> str:
+    """The workload fingerprint a ``latest`` alias is scoped to: the
+    (model, topology, dtype) identity, hashed the same way
+    :func:`maggy_tpu.autopilot.plan.workload_fingerprint` hashes its
+    scopes. Stamped INTO every alias record and verified on read."""
     payload = json.dumps(
         {"model": fingerprint, "topology": topology, "dtype": dtype},
         sort_keys=True,
     )
-    return "latest-" + hashlib.sha256(payload.encode()).hexdigest()[:24]
+    return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def alias_cache_key(fingerprint: str, topology: Dict[str, Any], dtype: str) -> str:
+    """Grid-independent pointer key: the LATEST winner for this (model,
+    topology, dtype) regardless of which grid found it. Consumers that never
+    tuned themselves (the serve CLI's ``--mesh auto``) look this up; exact
+    reproducibility consumers use the grid-bound :func:`cache_key`.
+
+    The alias is scoped per workload, not global: the key embeds the
+    workload fingerprint (so two concurrent jobs with different topologies
+    write DIFFERENT aliases — ``topology_key`` includes the process layout
+    for exactly this reason), and the record itself carries a ``workload``
+    stamp that :meth:`TuneCache.get_alias` verifies, so even a hash-level
+    collision or a stale/foreign record reads as a cache miss, never as
+    another workload's winner (last-writer-wins is gone both ways)."""
+    return "latest-" + alias_workload(fingerprint, topology, dtype)
 
 
 class TuneCache:
@@ -96,7 +118,10 @@ class TuneCache:
         # posixpath: correct for local paths and gs:// URLs alike
         return posixpath.join(self.env.root, self.SUBDIR, f"{key}.json")
 
-    def get(self, key: str) -> Optional[Dict[str, Any]]:
+    def get_record(self, key: str) -> Optional[Dict[str, Any]]:
+        """Raw record lookup — any dict-shaped JSON under the key (the
+        autopilot decision store rides this; tuning winners go through
+        :meth:`get`, which additionally demands a ``best`` field)."""
         path = self.path(key)
         try:
             if not self.env.exists(path):
@@ -104,10 +129,28 @@ class TuneCache:
             record = self.env.load_json(path)
         except (OSError, ValueError):
             return None
-        return record if isinstance(record, dict) and "best" in record else None
+        return record if isinstance(record, dict) else None
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        record = self.get_record(key)
+        return record if record is not None and "best" in record else None
+
+    def get_alias(self, key: str, workload: str) -> Optional[Dict[str, Any]]:
+        """Alias lookup scoped to a workload fingerprint: a record whose
+        ``workload`` stamp does not match the requester's is a MISS (a
+        clobbered or foreign alias must never hand back another job's
+        config), as is a legacy unstamped record."""
+        record = self.get(key)
+        if record is None or record.get("workload") != workload:
+            return None
+        return record
 
     def put(self, key: str, record: Dict[str, Any]) -> None:
         try:
-            self.env.dump(record, self.path(key))
+            # atomic publish where the env supports it (local FS: temp +
+            # rename): two concurrent tuners racing the same key must each
+            # leave a COMPLETE record, never interleaved JSON
+            dump = getattr(self.env, "_atomic_dump", self.env.dump)
+            dump(record, self.path(key))
         except OSError:
             pass  # a cold cache next run is the only consequence

@@ -2,27 +2,12 @@
 """Attribute where time went, per request and per training step, from the
 merged telemetry JSONL a run leaves behind.
 
-Input: an experiment dir (or its ``telemetry/`` subdir) holding the
-per-worker ``*.jsonl`` files (rotated ``*.jsonl.N`` segments are read too,
-oldest first). The request-scoped tracing layer (docs/observability.md)
-stamps every lifecycle event with a trace id, so one request's milestones —
-``req.accepted`` on the router, ``req.queued``/``req.admitted``/
-``req.first_token``/``req.finished`` on whichever replica served it,
-``req.requeued`` hops in between — line up on the shared wall clock no
-matter which worker wrote them.
-
-Attribution is gap-labeling: consecutive milestone pairs within one trace
-name the segment between them (accepted→dispatched = ``route``,
-queued→admitted = ``queue``, admitted→first_token = ``prefill``,
-first_token→finished = ``decode``, ...; unknown pairs land in ``other``).
-Segments therefore sum to the measured e2e by construction — the report's
-job is to show *which* bucket ate the time, the diagnosis input the
-autotune loop (ROADMAP item 4) consumes.
-
-Per-step attribution reads the training gauges: ``step_time_ms`` (host wall
-per step), ``input_wait_ms`` (blocked on the input pipeline), and
-``metrics_drain_ms`` (lagged broadcast reads), with the remainder reported
-as compute/dispatch.
+Thin CLI over :mod:`maggy_tpu.telemetry.attribution` — the SAME code path
+the autopilot Diagnoser (``maggy_tpu/autopilot/diagnose.py``) consumes, so
+the human report and the continuous-tuning loop always read identical
+numbers. ``--json`` prints the attribution as machine-readable JSON with a
+stable, versioned layout (``schema`` field; see the attribution module
+docstring for the field contract).
 
 Usage::
 
@@ -32,183 +17,25 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import List, Optional
 
-# (previous milestone, this milestone) -> attribution bucket; gaps between
-# consecutive lifecycle events not named here land in "other"
-GAP_LABELS: Dict[Tuple[str, str], str] = {
-    ("req.accepted", "req.dispatched"): "route",
-    ("req.requeued", "req.dispatched"): "route",
-    ("req.accepted", "req.shed"): "route",
-    ("req.dispatched", "req.queued"): "transit",
-    ("req.accepted", "req.queued"): "transit",
-    ("req.queued", "req.admitted"): "queue",
-    ("req.queued", "req.prefix_admitted"): "queue",
-    ("req.admitted", "req.first_token"): "prefill",
-    ("req.prefix_admitted", "req.first_token"): "prefill",
-    ("req.first_token", "req.finished"): "decode",
-    ("req.finished", "req.completed"): "completion",
-    ("req.queued", "req.requeued"): "lost",
-    ("req.admitted", "req.requeued"): "lost",
-    ("req.prefix_admitted", "req.requeued"): "lost",
-    ("req.first_token", "req.requeued"): "lost",
-    ("req.dispatched", "req.requeued"): "lost",
-    ("req.finished", "req.requeued"): "lost",
-    ("req.queued", "req.finished"): "queue",  # expired/cancelled in queue
-}
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
 
-COMPONENT_ORDER = (
-    "route",
-    "transit",
-    "queue",
-    "prefill",
-    "decode",
-    "lost",
-    "completion",
-    "other",
+from maggy_tpu.telemetry.attribution import (  # noqa: E402
+    COMPONENT_ORDER,
+    GAP_LABELS,  # noqa: F401 - re-exported for consumers of the old tool API
+    SCHEMA,  # noqa: F401
+    TERMINALS,  # noqa: F401
+    analyze,
+    attribute_requests,  # noqa: F401
+    attribute_steps,  # noqa: F401
+    iter_jsonl_files,  # noqa: F401
+    load_records,  # noqa: F401
+    summarize_requests,  # noqa: F401
 )
-
-TERMINALS = ("req.completed", "req.finished", "req.shed")
-
-
-def iter_jsonl_files(tdir: str) -> List[str]:
-    """All JSONL files under ``tdir``, rotated segments ordered oldest
-    first within each stem (``x.jsonl.3`` before ``x.jsonl.1`` before
-    ``x.jsonl``)."""
-    entries = []
-    for path in glob.glob(os.path.join(tdir, "*.jsonl*")):
-        base = os.path.basename(path)
-        stem, _, suffix = base.partition(".jsonl")
-        if suffix and not suffix[1:].isdigit():
-            continue  # not a rotation segment (e.g. .jsonl.tmp)
-        seg = int(suffix[1:]) if suffix else 0
-        entries.append((stem, -seg, path))
-    return [path for _, _, path in sorted(entries)]
-
-
-def load_records(tdir: str) -> List[Dict[str, Any]]:
-    records: List[Dict[str, Any]] = []
-    for path in iter_jsonl_files(tdir):
-        try:
-            with open(path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        records.append(json.loads(line))
-                    except ValueError:
-                        continue  # torn tail from a crashed worker
-        except OSError:
-            continue
-    return records
-
-
-# --------------------------------------------------------------- per request
-
-
-def attribute_requests(records: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
-    """One attribution row per trace that carries request lifecycle events:
-    ``{trace, rid, state, e2e_ms, components: {bucket: ms}}``. Components
-    sum to e2e_ms by construction (every inter-milestone gap is labeled)."""
-    by_trace: Dict[str, List[Dict[str, Any]]] = {}
-    for rec in records:
-        if rec.get("kind") != "event" or not rec.get("trace"):
-            continue
-        if not str(rec.get("name", "")).startswith("req."):
-            continue
-        by_trace.setdefault(rec["trace"], []).append(rec)
-    out = []
-    for trace, events in sorted(by_trace.items()):
-        events.sort(key=lambda e: float(e.get("ts", 0.0)))
-        # cut the timeline at the last terminal milestone: late duplicate
-        # polls after completion must not stretch the request
-        end_idx = max(
-            (i for i, e in enumerate(events) if e.get("name") in TERMINALS),
-            default=len(events) - 1,
-        )
-        events = events[: end_idx + 1]
-        components: Dict[str, float] = {}
-        for prev, cur in zip(events, events[1:]):
-            gap_ms = (float(cur["ts"]) - float(prev["ts"])) * 1e3
-            label = GAP_LABELS.get((prev["name"], cur["name"]), "other")
-            components[label] = components.get(label, 0.0) + max(0.0, gap_ms)
-        attrs = {}
-        for e in events:
-            attrs.update(e.get("attrs") or {})
-        out.append(
-            {
-                "trace": trace,
-                "rid": attrs.get("rid"),
-                "state": attrs.get("state", "?"),
-                "start_ts": float(events[0]["ts"]),
-                "e2e_ms": (float(events[-1]["ts"]) - float(events[0]["ts"])) * 1e3,
-                "hops": sum(1 for e in events if e["name"] == "req.requeued"),
-                "components": components,
-            }
-        )
-    return out
-
-
-def summarize_requests(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
-    if not rows:
-        return {"requests": 0}
-    total = {k: 0.0 for k in COMPONENT_ORDER}
-    for row in rows:
-        for k, v in row["components"].items():
-            total[k] = total.get(k, 0.0) + v
-    e2e_sum = sum(r["e2e_ms"] for r in rows)
-    return {
-        "requests": len(rows),
-        "requeue_hops": sum(r["hops"] for r in rows),
-        "e2e_ms_mean": e2e_sum / len(rows),
-        "components_ms_mean": {
-            k: v / len(rows) for k, v in total.items() if v > 0
-        },
-        "components_share": {
-            k: v / e2e_sum for k, v in total.items() if v > 0 and e2e_sum > 0
-        },
-    }
-
-
-# ------------------------------------------------------------------ per step
-
-
-def attribute_steps(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
-    """Training-loop attribution from the per-step gauges: where a mean
-    step's wall clock went (input wait, metrics drain, compute residual)."""
-    series: Dict[str, List[float]] = {}
-    for rec in records:
-        if rec.get("kind") != "gauge":
-            continue
-        name = rec.get("name")
-        if name in ("step_time_ms", "input_wait_ms", "metrics_drain_ms"):
-            try:
-                series.setdefault(name, []).append(float(rec.get("value", 0.0)))
-            except (TypeError, ValueError):
-                continue
-
-    def mean(name: str) -> Optional[float]:
-        vals = series.get(name)
-        return sum(vals) / len(vals) if vals else None
-
-    step = mean("step_time_ms")
-    wait = mean("input_wait_ms") or 0.0
-    drain = mean("metrics_drain_ms") or 0.0
-    out: Dict[str, Any] = {
-        "steps": len(series.get("step_time_ms", [])),
-        "step_ms_mean": step,
-        "input_wait_ms_mean": mean("input_wait_ms"),
-        "metrics_drain_ms_mean": mean("metrics_drain_ms"),
-    }
-    if step is not None:
-        out["compute_ms_est"] = max(0.0, step - wait - drain)
-    return out
-
 
 # ----------------------------------------------------------------- reporting
 
@@ -218,7 +45,7 @@ def _fmt_ms(v: Optional[float]) -> str:
 
 
 def render_report(rows, req_summary, step_summary, max_rows: int = 24) -> str:
-    lines = []
+    lines: List[str] = []
     if rows:
         lines.append(
             f"== per-request attribution ({len(rows)} request(s), ms) =="
@@ -263,29 +90,18 @@ def render_report(rows, req_summary, step_summary, max_rows: int = 24) -> str:
     return "\n".join(lines)
 
 
-def analyze(path: str) -> Dict[str, Any]:
-    tdir = path
-    sub = os.path.join(path, "telemetry")
-    if os.path.isdir(sub):
-        tdir = sub
-    records = load_records(tdir)
-    rows = attribute_requests(records)
-    return {
-        "telemetry_dir": tdir,
-        "requests": rows,
-        "request_summary": summarize_requests(rows),
-        "step_summary": attribute_steps(records),
-    }
-
-
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("path", help="experiment dir or its telemetry/ subdir")
-    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (stable schema; see "
+             "maggy_tpu/telemetry/attribution.py)",
+    )
     args = parser.parse_args(argv)
     result = analyze(args.path)
     if args.json:
-        print(json.dumps(result, indent=2, default=str))
+        print(json.dumps(result, indent=2, sort_keys=True, default=str))
     else:
         print(
             render_report(

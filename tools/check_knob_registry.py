@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Lint: every autopilot knob reference must be in the checked-in registry.
+
+Mirrors ``tools/check_telemetry_names.py`` for the autopilot's config
+surface. The failure mode it kills: the Planner emits a move for a knob
+nothing applies (a typo silently becomes a no-op that still burns a guard
+window), or a knob enters the playbook without declared bounds and a
+safe-live contract.
+
+* ``maggy_tpu/autopilot/knobs.py`` is the registry — a ``KNOBS`` table of
+  name → ``Knob(kind, bounds/choices, safe_live, scope)`` plus a
+  ``validate_registry()`` structural self-check (run here first: a knob
+  with missing bounds or an unprefixed name fails the lint even if nothing
+  references it).
+* This tool AST-walks ``maggy_tpu/`` and checks:
+  - every ``Move(...)`` call whose knob argument (first positional or
+    ``knob=``) is a string literal names a registered knob;
+  - every subscript ``KNOBS["..."]`` resolves;
+  - inside ``maggy_tpu/autopilot/``, every string literal shaped like a
+    knob name (``train.…``/``serve.…``/``fleet.…`` identifiers) is
+    registered — the playbook and targets live there, so a dotted literal
+    in that package IS a knob reference. (Telemetry names are exempt: the
+    ``autopilot.*`` prefix does not match the knob scopes.)
+
+Usage: ``python tools/check_knob_registry.py [root]`` — exits nonzero
+listing violations. Wired into the tier-1 run via
+``tests/test_autopilot.py``, beside the telemetry-name, host-sync,
+exception-hygiene, bare-print, and docs-nav lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+import sys
+from typing import List, Tuple
+
+KNOB_PATTERN = re.compile(r"^(train|serve|fleet)\.[a-z][a-z0-9_]*$")
+
+
+def load_registry(repo: str):
+    """Load knobs.py by path (no package import — it must stay stdlib-only)."""
+    path = os.path.join(repo, "maggy_tpu", "autopilot", "knobs.py")
+    spec = importlib.util.spec_from_file_location("maggy_tpu_knob_registry", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing resolves the defining module through sys.modules
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _literal(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def check_source(
+    source: str, path: str, registry, in_autopilot_pkg: bool
+) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    known = registry.KNOBS
+    tree = ast.parse(source, filename=path)
+    for node in ast.walk(tree):
+        # Move("<knob>", ...) / Move(knob="<knob>", ...)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+            if name == "Move":
+                knob = ""
+                if node.args:
+                    knob = _literal(node.args[0])
+                for kw in node.keywords:
+                    if kw.arg == "knob":
+                        knob = _literal(kw.value)
+                if knob and knob not in known:
+                    out.append(
+                        (
+                            node.lineno,
+                            f"Move({knob!r}) targets an unregistered knob — "
+                            "declare it in maggy_tpu/autopilot/knobs.py",
+                        )
+                    )
+        # KNOBS["<knob>"]
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            base_name = (
+                base.id if isinstance(base, ast.Name) else getattr(base, "attr", "")
+            )
+            if base_name == "KNOBS":
+                knob = _literal(node.slice)
+                if knob and knob not in known:
+                    out.append(
+                        (node.lineno, f"KNOBS[{knob!r}] is not registered")
+                    )
+        # inside the autopilot package any knob-shaped literal is a reference
+        if in_autopilot_pkg and isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, str) and KNOB_PATTERN.match(v) and v not in known:
+                out.append(
+                    (
+                        node.lineno,
+                        f"knob-shaped literal {v!r} is not in the registry — "
+                        "register it or rename the string",
+                    )
+                )
+    return out
+
+
+def check_tree(root: str, registry) -> List[Tuple[str, int, str]]:
+    violations: List[Tuple[str, int, str]] = []
+    ap_pkg = os.path.join("maggy_tpu", "autopilot")
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))
+        ]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            try:
+                hits = check_source(
+                    source, path, registry, in_autopilot_pkg=ap_pkg in path
+                )
+            except SyntaxError as e:
+                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
+                continue
+            violations.extend((path, line, what) for line, what in hits)
+    return violations
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = args[0] if args else os.path.join(repo, "maggy_tpu")
+    registry = load_registry(repo)
+    violations = [
+        (os.path.join(repo, "maggy_tpu", "autopilot", "knobs.py"), 0, err)
+        for err in registry.validate_registry()
+    ]
+    violations.extend(check_tree(root, registry))
+    for path, line, what in violations:
+        print(f"{path}:{line}: {what}", file=sys.stderr)
+    if violations:
+        print(f"{len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

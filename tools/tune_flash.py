@@ -6,6 +6,11 @@ Round-2 found 512-row forward q tiles ~2.7x faster than the conventional 128
 the bench geometry and prints a ranked table — run it when the tunnel is
 alive, then bake the winner into _auto_blocks' backward variant.
 
+The tile grid is the autopilot knob registry's ``FLASH_TILE_CHOICES``
+(maggy_tpu/autopilot/knobs.py) — the manual sweep and the Planner's
+compute-bound recommendations draw candidates from the same table, so a
+tile this tool can measure is always one the autopilot may legally plan.
+
     python tools/tune_flash.py [--seq 1024] [--steps 10]
 """
 
@@ -50,7 +55,9 @@ def main():
     k = jax.random.normal(jax.random.key(2), (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.key(3), (B, S, H, D), jnp.bfloat16)
 
-    cands = [c for c in (128, 256, 512, 1024) if c <= S] or [S]
+    from maggy_tpu.autopilot.knobs import FLASH_TILE_CHOICES
+
+    cands = [c for c in FLASH_TILE_CHOICES if c <= S] or [S]
     if cpu or args.quick:
         cands = cands[:2]
 
