@@ -43,6 +43,9 @@ class DistributedConfig(LagomConfig):
         coordinator_port: Optional[int] = None,
         evaluator: bool = False,
         max_restarts: int = 0,
+        elastic: bool = False,
+        min_slices: int = 1,
+        num_slices: Optional[int] = None,
     ):
         """:param module: a flax ``nn.Module`` class, instance, or zero-arg factory —
             the analogue of the reference's torch module class argument
@@ -119,6 +122,30 @@ class DistributedConfig(LagomConfig):
         if max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
         self.max_restarts = int(max_restarts)
+        # Elastic membership (docs/resilience.md "Elastic membership"):
+        # instead of burning a restart slot to relaunch a lost slice at the
+        # SAME world size, the data mesh reshapes — epoch-numbered
+        # membership views, survivors converge on the latest complete
+        # checkpoint and continue at reduced width; a rejoining slice
+        # reshapes back. min_slices gates how far the mesh may shrink
+        # (violation = clean deterministic abort). num_slices > num_executors
+        # with one executor simulates that many slices as contiguous
+        # partitions of the local device mesh (CPU-testable geometries);
+        # with num_executors > 1 each worker process is one slice.
+        # Elastic runs need a checkpointer + fit(resume="auto") in the
+        # train_fn — the reshape's convergence point is a checkpoint.
+        self.elastic = bool(elastic)
+        if min_slices < 1:
+            raise ValueError("min_slices must be >= 1")
+        self.min_slices = int(min_slices)
+        if num_slices is not None and num_slices < 1:
+            raise ValueError("num_slices must be >= 1")
+        self.num_slices = num_slices
+        if self.evaluator and self.elastic:
+            raise ValueError(
+                "elastic=True does not compose with evaluator=True: the "
+                "evaluator partition sits outside the training membership"
+            )
 
     def resolve_sharding(self, num_devices: int) -> ShardingSpec:
         if isinstance(self.sharding, ShardingSpec):

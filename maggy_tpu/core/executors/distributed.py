@@ -16,13 +16,21 @@ from __future__ import annotations
 
 import os
 import socket as socket_mod
+import time
 import traceback
 from typing import Callable, Optional
 
 from maggy_tpu import util
 from maggy_tpu.core.env import EnvSing
-from maggy_tpu.exceptions import EarlyStopException, WorkerLost
+from maggy_tpu.exceptions import EarlyStopException, RpcError, WorkerLost
 from maggy_tpu.reporter import Reporter
+from maggy_tpu.resilience.membership import (
+    MembershipChanged,
+    MembershipMonitor,
+    MembershipView,
+    SliceLost,
+    SliceRejoin,
+)
 
 
 def dist_executor_fn(
@@ -72,8 +80,17 @@ def dist_executor_fn(
                 client.await_reservations()
             exec_config = client.get_message("EXEC_CONFIG")
 
-            with tel.span("build_context"):
-                ctx = _build_context(exec_config, config)
+            # elastic membership (docs/resilience.md): the monitor holds the
+            # view this worker's mesh is built for; heartbeats report its
+            # epoch and a RESHAPE reply flags it for the next step boundary
+            monitor = None
+            if exec_config.get("membership"):
+                view = MembershipView.from_dict(exec_config["membership"])
+                monitor = MembershipMonitor(
+                    view,
+                    self_slice=partition_id if view.mode == "workers" else None,
+                )
+                client.membership = monitor
             reporter.reset(trial_id=f"dist_{partition_id}")
             worker_dir = os.path.join(exp_dir, f"worker_{partition_id}")
 
@@ -84,49 +101,77 @@ def dist_executor_fn(
             dataset = config.dataset
             if config.process_data is not None:
                 dataset = config.process_data(dataset)
-            available = {
-                "module": module,
-                "model": module,
-                "dataset": dataset,
-                "hparams": hparams,
-                "reporter": reporter,
-                "ctx": ctx,
-                "train_ctx": ctx,
-                "mesh": ctx.mesh,
-                "trial_dir": worker_dir,
-                "rng": _seed_key(config.seed),
-            }
-            kwargs = util.inject_kwargs(train_fn, available)
 
             metric = None
             outputs = {}
             error = None
-            try:
-                # train_fn prints ship with the heartbeat logs, same as the
-                # trial executor (reference trial_executor.py:93-103)
-                from maggy_tpu.reporter import capture_prints
+            while True:
+                with tel.span("build_context"):
+                    ctx = _build_context(exec_config, config, monitor)
+                available = {
+                    "module": module,
+                    "model": module,
+                    "dataset": dataset,
+                    "hparams": hparams,
+                    "reporter": reporter,
+                    "ctx": ctx,
+                    "train_ctx": ctx,
+                    "mesh": ctx.mesh,
+                    "trial_dir": worker_dir,
+                    "rng": _seed_key(config.seed),
+                }
+                kwargs = util.inject_kwargs(train_fn, available)
+                try:
+                    # train_fn prints ship with the heartbeat logs, same as
+                    # the trial executor (reference trial_executor.py:93-103)
+                    from maggy_tpu.reporter import capture_prints
 
-                with tel.span("train_fn", partition=partition_id), capture_prints(reporter):
-                    retval = train_fn(**kwargs)
-                if retval is not None:
-                    # per-worker dir: concurrent workers must not clobber
-                    # outputs. The evaluator's outputs are free-form (no
-                    # optimization-key requirement) but persist identically.
-                    metric, outputs = util.normalize_return_val(
-                        retval, "metric", require_metric=ctx.role != "evaluator"
+                    with tel.span(
+                        "train_fn", partition=partition_id
+                    ), capture_prints(reporter):
+                        retval = train_fn(**kwargs)
+                    if retval is not None:
+                        # per-worker dir: concurrent workers must not clobber
+                        # outputs. The evaluator's outputs are free-form (no
+                        # optimization-key requirement) but persist identically.
+                        metric, outputs = util.normalize_return_val(
+                            retval, "metric", require_metric=ctx.role != "evaluator"
+                        )
+                        util.persist_outputs(outputs, metric, worker_dir)
+                    break
+                except EarlyStopException as e:
+                    metric = e.metric
+                    outputs = {"metric": metric}
+                    break
+                except (SliceLost, SliceRejoin, MembershipChanged) as e:
+                    if monitor is None:
+                        raise  # not elastic: SliceLost reads as worker death
+                    if (
+                        isinstance(e, SliceLost)
+                        and monitor.self_slice is not None
+                        and e.slice_id == monitor.self_slice
+                    ):
+                        # this worker IS the lost slice: die like one — the
+                        # driver's death hook turns it into a membership
+                        # drop and the survivors reshape
+                        raise
+                    # the reshape loop: report the event, wait out the
+                    # barrier, rebuild for the new view, re-enter train_fn
+                    # (which resumes from the latest complete checkpoint)
+                    exec_config = _reshape(client, monitor, config, e, tel, reporter)
+                except WorkerLost:
+                    # worker death (preemption / chaos kill): no FINAL — the
+                    # executor dies and the driver's elastic path
+                    # (max_restarts relaunch, or a membership drop when
+                    # elastic=True) takes over
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    error = f"{type(e).__name__}: {e}"
+                    reporter.log(
+                        f"Distributed worker {partition_id} failed:\n"
+                        f"{traceback.format_exc()}"
                     )
-                    util.persist_outputs(outputs, metric, worker_dir)
-            except EarlyStopException as e:
-                metric = e.metric
-                outputs = {"metric": metric}
-            except WorkerLost:
-                # worker death (preemption / chaos kill): no FINAL — the
-                # executor dies and the driver's elastic-restart path
-                # (DistributedConfig(max_restarts=...)) takes over
-                raise
-            except Exception as e:  # noqa: BLE001
-                error = f"{type(e).__name__}: {e}"
-                reporter.log(f"Distributed worker {partition_id} failed:\n{traceback.format_exc()}")
+                    break
             tel.flush()  # events are durable before FINAL ships
             client.finalize_metric(
                 f"dist_{partition_id}", metric, outputs=util._jsonify(outputs), error=error
@@ -137,7 +182,66 @@ def dist_executor_fn(
             telemetry.set_current(None)
             tel.close()
 
-    def _build_context(exec_config, config):
+    def _reshape(client, monitor, config, event, tel, reporter):
+        """One membership transition on the worker side: report the observed
+        slice event (if this worker observed one), wait at the reshape
+        barrier until every member acked the new epoch, adopt the view, and
+        re-run the EXEC_CONFIG exchange for the new layout."""
+        old_epoch = monitor.epoch
+        kind = (
+            "drop"
+            if isinstance(event, SliceLost)
+            else "rejoin"
+            if isinstance(event, SliceRejoin)
+            else None
+        )
+        if kind is not None:
+            client.request(
+                {
+                    "type": "SLICE_EVENT",
+                    "kind": kind,
+                    "slice": event.slice_id,
+                    "step": event.step,
+                }
+            )
+        reporter.log(
+            f"Worker {partition_id}: membership event ({event}); awaiting "
+            "reshape barrier"
+        )
+        t0 = time.perf_counter()
+        deadline = time.time() + float(
+            os.environ.get("MAGGY_TPU_RESHAPE_TIMEOUT", "120")
+        )
+        acked = old_epoch
+        while True:
+            reply = client.request({"type": "MEMBERSHIP", "epoch": acked})
+            if reply.get("aborted"):
+                raise RpcError(
+                    "membership reshape aborted by the driver (see the "
+                    "experiment error — e.g. a min_slices violation)"
+                )
+            view = MembershipView.from_dict(reply["view"])
+            acked = view.epoch
+            if view.epoch > old_epoch and reply.get("ready"):
+                monitor.adopt(view)
+                break
+            if time.time() > deadline:
+                raise RpcError(
+                    f"reshape barrier for epoch > {old_epoch} did not "
+                    "complete within MAGGY_TPU_RESHAPE_TIMEOUT"
+                )
+            time.sleep(0.01)
+        tel.gauge("resilience.membership_epoch", view.epoch)
+        tel.gauge("resilience.active_slices", view.n_active)
+        reporter.log(
+            f"Worker {partition_id}: reshaped to membership epoch "
+            f"{view.epoch} (active slices {list(view.active)}/"
+            f"{view.total_slices}, {(time.perf_counter() - t0) * 1e3:.0f}ms "
+            "barrier); resuming from the latest complete checkpoint"
+        )
+        return client.get_message("EXEC_CONFIG")
+
+    def _build_context(exec_config, config, monitor=None):
         import jax
 
         from maggy_tpu.train.trainer import TrainContext
@@ -145,6 +249,21 @@ def dist_executor_fn(
         num_processes = exec_config.get("num_processes", 1)
         data_plane = getattr(config, "data_plane", "auto")
         mesh_devices = devices if devices else None
+        membership = exec_config.get("membership") or {}
+        if monitor is not None and membership.get("mode") == "sim":
+            # simulated slices (docs/distributed.md "Slice topology"): this
+            # worker's device lease splits into total_slices contiguous
+            # partitions; the mesh spans the ACTIVE ones under an outer
+            # `slice` axis, so n=16+ elastic geometries run on the CPU mesh
+            view = monitor.view
+            return TrainContext.create_sliced(
+                config.sharding,
+                total_slices=view.total_slices,
+                active=view.active,
+                devices=mesh_devices,
+                role="chief" if partition_id == 0 else "worker",
+                membership=monitor,
+            )
         if exec_config.get("evaluator_partition") == partition_id:
             # dedicated evaluation role (reference tf_dist_executor.py:138-144):
             # outside the training group, so never part of a global mesh —
@@ -175,7 +294,9 @@ def dist_executor_fn(
         n = len(mesh_devices) if mesh_devices is not None else len(jax.devices())
         spec = config.resolve_sharding(n)
         role = "chief" if partition_id == 0 else "worker"
-        return TrainContext.create(spec, devices=mesh_devices, role=role)
+        return TrainContext.create(
+            spec, devices=mesh_devices, role=role, membership=monitor
+        )
 
     return _executor
 

@@ -392,6 +392,10 @@ class Client:
         # one nonce per client instance: lets the server tell a retried REG
         # (same nonce) from a restarted worker (new nonce)
         self.attempt_id = secrets_mod.token_hex(8)
+        # elastic membership (docs/resilience.md): the executor installs its
+        # MembershipMonitor here; every beat then reports the epoch the
+        # worker is running under, and a RESHAPE reply signals the monitor
+        self.membership = None
         self._main_sock = self._connect()
         self._main_lock = threading.Lock()
         self._hb_sock: Optional[socket.socket] = None
@@ -563,6 +567,11 @@ class Client:
             "step": step,
             "logs": logs,
         }
+        membership = self.membership
+        if membership is not None:
+            # the driver compares this against its membership view and
+            # replies RESHAPE when this worker is running a stale epoch
+            beat["epoch"] = membership.epoch
         if tel is not None and tel.active:
             snap = tel.snapshot()
             if snap:
@@ -580,6 +589,10 @@ class Client:
             tel.flush()
         if reply.get("type") == "STOP":
             reporter.early_stop()
+        elif reply.get("type") == "RESHAPE" and membership is not None:
+            # membership moved: Trainer.fit sees the pending epoch at its
+            # next step boundary and raises MembershipChanged
+            membership.signal(reply.get("epoch"))
 
     def stop(self) -> None:
         self._hb_stop.set()

@@ -170,6 +170,15 @@ def _telemetry_lines(status: dict, width: int) -> list:
         # resilience counters (maggy_tpu/resilience): what the runtime
         # absorbed — requeued/exhausted trials, quarantines, worker deaths,
         # elastic restarts, auto-resumes, preemption saves
+        # elastic membership gauges: epoch/active width and the last
+        # reshape-barrier latency (docs/resilience.md)
+        if "resilience.active_slices" in g:
+            parts.append(
+                f"slices {g['resilience.active_slices']:.0f}"
+                f"@e{g.get('resilience.membership_epoch', 0):.0f}"
+            )
+        if "resilience.reshape_ms" in g:
+            parts.append(f"reshape {g['resilience.reshape_ms']:.0f}ms")
         c = snap.get("counters") or {}
         res = {
             k[len("resilience."):]: v
@@ -391,6 +400,19 @@ def render_status(status: dict, width: int = 78) -> str:
             )
             + (f"  {elapsed:.0f}s" if elapsed is not None else "")
         )
+        if status.get("membership_epoch") is not None:
+            # elastic membership (docs/resilience.md): current epoch and
+            # which slices are in the data mesh vs the launch width
+            active = status.get("active_slices") or []
+            total = status.get("num_slices", len(active))
+            lines.append(
+                (
+                    f"membership: epoch={status['membership_epoch']}"
+                    f"  slices {len(active)}/{total} active {active}"
+                    f"  min={status.get('min_slices', 1)}"
+                    f"  mode={status.get('membership_mode', '?')}"
+                )[:width]
+            )
         seen = status.get("last_seen") or {}
         if seen:
             lines.append(_heartbeat_line(seen))

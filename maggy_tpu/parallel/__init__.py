@@ -3,17 +3,23 @@ from maggy_tpu.parallel.spec import (
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_SEQ,
+    AXIS_SLICE,
     AXIS_TENSOR,
     MESH_AXES,
+    SLICE_MESH_AXES,
     ShardingSpec,
+    SliceTopology,
 )
 
 __all__ = [
     "ShardingSpec",
+    "SliceTopology",
     "MESH_AXES",
+    "SLICE_MESH_AXES",
     "AXIS_DATA",
     "AXIS_FSDP",
     "AXIS_EXPERT",
     "AXIS_SEQ",
+    "AXIS_SLICE",
     "AXIS_TENSOR",
 ]

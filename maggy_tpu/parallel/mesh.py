@@ -18,7 +18,12 @@ from typing import List, Optional
 
 import numpy as np
 
-from maggy_tpu.parallel.spec import MESH_AXES, ShardingSpec
+from maggy_tpu.parallel.spec import (
+    MESH_AXES,
+    SLICE_MESH_AXES,
+    ShardingSpec,
+    SliceTopology,
+)
 
 
 def make_mesh(spec: ShardingSpec, devices: Optional[List] = None):
@@ -35,6 +40,58 @@ def make_mesh(spec: ShardingSpec, devices: Optional[List] = None):
         )
     arr = np.asarray(devices).reshape(spec.axis_sizes())
     return Mesh(arr, MESH_AXES)
+
+
+def make_slice_mesh(topology: SliceTopology, devices: Optional[List] = None):
+    """Build a Mesh with the outer ``slice`` axis for ``topology``.
+
+    ``devices`` must list the active slices' devices slice-contiguously
+    (slice 0's devices, then slice 1's, ...) — ``slice_device_groups``
+    produces exactly that ordering for simulated slices, and
+    ``jax.devices()`` already orders a real multi-slice fleet this way
+    (slice-major). Elastic reshape = call again with the surviving slices'
+    devices and ``topology.with_slices(len(survivors))``.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if topology.num_devices != len(devices):
+        raise ValueError(
+            f"SliceTopology covers {topology.num_devices} devices "
+            f"({topology.n_slices} slice(s) x {topology.devices_per_slice}) "
+            f"but {len(devices)} are provided"
+        )
+    arr = np.asarray(devices).reshape(topology.axis_sizes())
+    return Mesh(arr, SLICE_MESH_AXES)
+
+
+def slice_device_groups(n_slices: int, devices: Optional[List] = None) -> List[list]:
+    """Partition a device list into ``n_slices`` contiguous simulated
+    slices (slice-major order, matching ``make_slice_mesh``'s expectation).
+
+    This generalizes the dryrun machinery: with
+    ``xla_force_host_platform_device_count=16`` a 4-slice x 4-chip elastic
+    geometry runs entirely on the CPU mesh, so membership reshape and the
+    cross-slice collective layout are testable without a fleet. The device
+    count must divide evenly — ragged slices would make the reshape's
+    per-slice program shapes diverge.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    if len(devices) % n_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} equal "
+            "slices; adjust num_slices or the device count"
+        )
+    per = len(devices) // n_slices
+    return [devices[i * per : (i + 1) * per] for i in range(n_slices)]
 
 
 def ambient_mesh():

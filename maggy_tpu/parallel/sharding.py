@@ -18,6 +18,7 @@ from maggy_tpu.parallel.spec import (
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_SEQ,
+    AXIS_SLICE,
     AXIS_TENSOR,
 )
 
@@ -40,6 +41,27 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("conv_in", None),
     ("conv_out", AXIS_FSDP),
 )
+
+
+def slice_rules(rules=DEFAULT_RULES) -> Tuple[Tuple[str, Any], ...]:
+    """The rule table for a slice-topology mesh: ``batch`` additionally
+    spans the outer ``slice`` axis, so the per-step gradient sync
+    decomposes hierarchically — reduce-scatter/all-gather over ``fsdp``
+    inside a slice (ICI), one all-reduce over ``slice`` across slices
+    (DCN-tolerant). Every other rule is unchanged: params never shard over
+    ``slice``, which is what keeps a membership reshape a pure
+    re-placement."""
+    out = []
+    for name, axis in rules:
+        if name == "batch":
+            cur = (
+                tuple(axis)
+                if isinstance(axis, (tuple, list))
+                else ((axis,) if axis is not None else ())
+            )
+            axis = (AXIS_SLICE,) + cur
+        out.append((name, axis))
+    return tuple(out)
 
 
 def logical_to_mesh_axes(
@@ -251,6 +273,17 @@ def constrain_activation(x, logical_axes, rules=DEFAULT_RULES):
         if bound & set(mesh.axis_names):
             return x
     axes = list(logical_to_mesh_axes(logical_axes, rules))
+    # slice-topology meshes: models pin activations with the DEFAULT rule
+    # table, whose batch rule knows nothing of the outer slice axis — a
+    # (data, fsdp)-only constraint there would force a cross-slice row
+    # gather every layer. Widen batch constraints to include slice so the
+    # pin agrees with the input placement.
+    if dict(mesh.shape).get(AXIS_SLICE, 1) > 1:
+        for i, (name, axis) in enumerate(zip(logical_axes, axes)):
+            if name == "batch" and axis is not None:
+                cur = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+                if AXIS_SLICE not in cur:
+                    axes[i] = (AXIS_SLICE,) + cur
     for i, axis in enumerate(axes):
         ext = mesh_extent(mesh, axis)
         if ext > 1 and x.shape[i] % ext:

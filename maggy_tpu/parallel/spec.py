@@ -25,8 +25,16 @@ AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
+# outermost-of-all data axis for multi-slice topologies: one coordinate per
+# ICI-connected slice, so the only collective that crosses it is the one
+# gradient all-reduce per step (DCN-tolerant), while fsdp's per-layer
+# reduce-scatter/all-gather stays inside a slice (ICI) — hierarchical data
+# parallelism per the TPU concurrency-limits recipe (PAPERS.md)
+AXIS_SLICE = "slice"
 
 MESH_AXES = (AXIS_STAGE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+# multi-slice meshes prepend the slice axis; single-slice code never sees it
+SLICE_MESH_AXES = (AXIS_SLICE,) + MESH_AXES
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +108,46 @@ class ShardingSpec:
                 f"{num_devices} devices not divisible by non-dp axes product {rest}"
             )
         return dataclasses.replace(self, dp=num_devices // rest)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """A multi-slice mesh layout: ``n_slices`` ICI domains, each running
+    ``slice_spec`` internally, joined by an outer :data:`AXIS_SLICE` data
+    axis (DCN on real fleets; simulated device partitions on one host).
+
+    This is the elastic-membership unit of failure: when a slice leaves or
+    rejoins, only ``n_slices`` changes — the per-slice layout (and thus the
+    per-slice compiled program structure) is preserved, which is what makes
+    the reshape a re-placement rather than a re-plan.
+    """
+
+    n_slices: int = 1
+    slice_spec: ShardingSpec = dataclasses.field(default_factory=ShardingSpec)
+
+    def __post_init__(self):
+        if not isinstance(self.n_slices, int) or self.n_slices < 1:
+            raise ValueError(
+                f"SliceTopology.n_slices must be a positive int, got "
+                f"{self.n_slices!r}"
+            )
+
+    @property
+    def num_devices(self) -> int:
+        return self.n_slices * self.slice_spec.num_devices
+
+    @property
+    def devices_per_slice(self) -> int:
+        return self.slice_spec.num_devices
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        """Extent per :data:`SLICE_MESH_AXES` entry (slice outermost)."""
+        return (self.n_slices,) + self.slice_spec.axis_sizes()
+
+    def with_slices(self, n_slices: int) -> "SliceTopology":
+        """The same per-slice layout at a different width — the membership
+        reshape transition."""
+        return dataclasses.replace(self, n_slices=n_slices)
 
 
 def _largest_factor_leq(n: int, cap: int) -> int:

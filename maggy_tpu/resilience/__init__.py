@@ -17,8 +17,14 @@ execution tiers thread through:
   reclaim).
 * :mod:`maggy_tpu.resilience.chaos` — deterministic fault injector (kill
   worker N at step K, drop heartbeats, stall an RPC reply, truncate a
-  checkpoint) on a config/env seam, so every recovery path is testable on
-  CPU without real preemptions.
+  checkpoint, drop/rejoin a data-mesh slice) on a config/env seam, so every
+  recovery path is testable on CPU without real preemptions. The kind set
+  is closed by a checked-in registry (``chaos.KINDS`` +
+  ``tools/check_chaos_kinds.py``).
+* :mod:`maggy_tpu.resilience.membership` — epoch-numbered elastic
+  membership views: the data mesh reshapes checkpoint-consistently when a
+  slice leaves or rejoins (``DistributedConfig(elastic=True,
+  min_slices=...)``), instead of dying once ``max_restarts`` is exhausted.
 
 Consumers: ``core/driver/hpo.py`` (trial requeue + quarantine),
 ``core/driver/distributed.py`` (bounded elastic restart),
@@ -31,6 +37,14 @@ absorbed.
 
 from __future__ import annotations
 
+from maggy_tpu.resilience.membership import (  # noqa: F401
+    MembershipChanged,
+    MembershipMonitor,
+    MembershipView,
+    MembershipViolation,
+    SliceLost,
+    SliceRejoin,
+)
 from maggy_tpu.resilience.policy import (  # noqa: F401
     DETERMINISTIC,
     TRANSIENT,
@@ -45,4 +59,10 @@ __all__ = [
     "classify_failure",
     "RetryPolicy",
     "QuarantineTracker",
+    "MembershipView",
+    "MembershipMonitor",
+    "MembershipChanged",
+    "MembershipViolation",
+    "SliceLost",
+    "SliceRejoin",
 ]

@@ -24,15 +24,26 @@ Seams (all zero-cost when no plan is installed):
   replica's RPC port closes and its scheduler is abandoned mid-decode,
   simulating a preempted serving host (the router must requeue its
   in-flight requests to survivors; docs/fleet.md).
+* ``Trainer.fit`` consults ``slice_drop`` / ``slice_rejoin`` each step when
+  running under an elastic membership monitor — a matching ``slice_drop``
+  raises :class:`~maggy_tpu.resilience.membership.SliceLost` (the slice's
+  devices are gone: fall back to the last retained checkpoint), a matching
+  ``slice_rejoin`` re-admits a previously dropped slice gracefully (fit
+  checkpoints first). Both drive the mesh-reshape protocol end to end
+  (docs/resilience.md "Elastic membership").
 
 Activation: install programmatically (``chaos.install(Chaos.parse(spec))``)
 or via ``MAGGY_TPU_CHAOS=<spec>`` in the environment — the env seam reaches
 subprocess workers the same way the telemetry flag does. Spec grammar::
 
     MAGGY_TPU_CHAOS="kill:worker=1,step=3;hb_drop:worker=0,times=5;rpc_stall:verb=GET,secs=0.2"
+    MAGGY_TPU_CHAOS="slice_drop:slice=1,step=4;slice_rejoin:slice=1,step=8"
 
 Rules are ``kind:key=value,...`` joined by ``;``. ``times`` bounds firings
-(default 1); omitted match keys match anything.
+(default 1); omitted match keys match anything. Every kind must be declared
+in :data:`KINDS` — ``tools/check_chaos_kinds.py`` (tier-1) closes the kind
+set the same way the telemetry-name lint closes the metric set, so a typo'd
+kind (``slice_dorp``) fails the lint instead of silently never firing.
 """
 
 from __future__ import annotations
@@ -45,6 +56,21 @@ from typing import Any, Dict, List, Optional, Tuple
 from maggy_tpu.exceptions import WorkerLost
 
 ENV_VAR = "MAGGY_TPU_CHAOS"
+
+# The closed set of chaos kinds. Every rule a spec names and every
+# ``Chaos.fire(kind, ...)`` seam in maggy_tpu/ and tests/ must use a kind
+# declared here — tools/check_chaos_kinds.py lints both sides (tier-1).
+# Adding a fault kind = declare it here + add its seam method below.
+KINDS = frozenset(
+    {
+        "kill",  # raise WorkerKilled in Trainer.fit (worker N at step K)
+        "hb_drop",  # swallow a worker's next heartbeat (silent worker)
+        "rpc_stall",  # delay one verb's reply (wedged driver host)
+        "replica_kill",  # kill a serving fleet replica mid-stream
+        "slice_drop",  # a slice leaves the elastic data mesh at step K
+        "slice_rejoin",  # a dropped slice comes back at step K
+    }
+)
 
 
 class WorkerKilled(WorkerLost):
@@ -93,7 +119,13 @@ class Chaos:
                     arg = float(value)
                 else:
                     match[key.strip()] = value.strip()
-            faults.append(Fault(kind.strip(), match, times=times, arg=arg))
+            kind = kind.strip()
+            if kind not in KINDS:
+                raise ValueError(
+                    f"chaos rule {rule!r}: unknown kind {kind!r} "
+                    f"(declared kinds: {sorted(KINDS)})"
+                )
+            faults.append(Fault(kind, match, times=times, arg=arg))
         return cls(faults)
 
     def fire(self, kind: str, **attrs: Any) -> Optional[Fault]:
@@ -136,6 +168,25 @@ class Chaos:
         router's pump consults it only while the replica is mid-stream, so
         a matching rule always exercises requeue-to-survivors)."""
         return self.fire("replica_kill", replica=replica) is not None
+
+    def slice_drop(self, slices, step: Optional[int] = None) -> Optional[Any]:
+        """The id of the ACTIVE slice a ``slice_drop`` rule kills at this
+        step (None = no rule fired). At most one slice drops per call — a
+        multi-slice outage is spelled as multiple rules firing on
+        consecutive steps, which exercises the reshape path once per loss
+        the way real preemptions arrive."""
+        for s in slices:
+            if self.fire("slice_drop", slice=s, step=step) is not None:
+                return s
+        return None
+
+    def slice_rejoin(self, slices, step: Optional[int] = None) -> Optional[Any]:
+        """The id of the INACTIVE slice a ``slice_rejoin`` rule re-admits
+        at this step (None = no rule fired)."""
+        for s in slices:
+            if self.fire("slice_rejoin", slice=s, step=step) is not None:
+                return s
+        return None
 
 
 def truncate_checkpoint(directory: str, step: Optional[int] = None) -> int:

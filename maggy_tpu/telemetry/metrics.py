@@ -56,6 +56,10 @@ GAUGES = frozenset(
         "tune.best_step_time",
         # autopilot online controller (autopilot/controller.py)
         "autopilot.tick_ms",  # per-sample controller cost (≤2% budget)
+        # elastic membership (resilience/membership.py, core/driver/distributed.py)
+        "resilience.membership_epoch",  # current membership epoch
+        "resilience.active_slices",  # slices currently in the data mesh
+        "resilience.reshape_ms",  # epoch bump -> reshape barrier complete
     }
 )
 
@@ -78,6 +82,11 @@ COUNTERS = frozenset(
         "resilience.trials_requeued",
         "resilience.trials_exhausted",
         "resilience.dist_restarts",
+        # elastic membership (docs/resilience.md "Elastic membership")
+        "resilience.slice_drops",  # slices that left the data mesh
+        "resilience.slice_rejoins",  # dropped slices re-admitted
+        "resilience.reshape_checkpoints",  # graceful-reshape convergence saves
+        "resilience.ckpt_reshards",  # restores re-placed across mesh layouts
         "tune.cache_hits",
         "tune.cache_misses",
         "flightrec.dumps",  # stall watchdog dumps written (telemetry/flightrec.py)

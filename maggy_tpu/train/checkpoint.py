@@ -89,6 +89,10 @@ class Checkpointer:
         except (OSError, ValueError):
             return None
 
+    # mesh/world-size keys get the dedicated warn-and-reshard signal in
+    # _check_reshard; _check_meta covers the rest (microbatch, dtype, ...)
+    _LAYOUT_KEYS = ("mesh_axes", "num_devices", "n_processes")
+
     def _check_meta(self, step: int, expect_meta: Dict[str, Any]) -> None:
         """Warn (never fail) when the checkpoint's recorded system config
         disagrees with the live one on any shared key — restoring across
@@ -103,7 +107,7 @@ class Checkpointer:
         expect = _jsonify(expect_meta)
         diffs = [
             f"{k}: saved={saved[k]!r} live={expect[k]!r}"
-            for k in sorted(set(saved) & set(expect))
+            for k in sorted(set(saved) & set(expect) - set(self._LAYOUT_KEYS))
             if saved[k] != expect[k]
         ]
         if diffs:
@@ -112,6 +116,57 @@ class Checkpointer:
                 f"config than the live one ({'; '.join(diffs)}); the state "
                 "will be re-placed onto the live mesh, but training dynamics "
                 "(batch/microbatch semantics) may differ",
+                stacklevel=3,
+            )
+
+    @staticmethod
+    def _template_layout(state_template: Any) -> Optional[Dict[str, Any]]:
+        """The live mesh layout implied by the restore template's leaf
+        shardings (None when the template carries no mesh — e.g. plain
+        numpy trees in unit tests)."""
+        import jax
+
+        for leaf in jax.tree.leaves(state_template):
+            sharding = getattr(leaf, "sharding", None)
+            mesh = getattr(sharding, "mesh", None)
+            if mesh is not None and getattr(mesh, "shape", None) is not None:
+                try:
+                    return {
+                        "mesh_axes": {
+                            k: v for k, v in dict(mesh.shape).items() if v > 1
+                        },
+                        "num_devices": int(mesh.size),
+                        "n_processes": int(jax.process_count()),
+                    }
+                except (TypeError, ValueError):
+                    return None
+        return None
+
+    def _check_reshard(self, step: int, state_template: Any) -> None:
+        """Warn-and-reshard (docs/resilience.md): when the sidecar meta
+        records a different mesh/world size than the template's live mesh,
+        say so explicitly — the restore still proceeds (device_put onto the
+        template's shardings re-places every leaf), but a silent cross-mesh
+        restore has mis-sharded enough runs that the transition deserves a
+        loud signal and a counter. This is the world-size-independent
+        restore the elastic membership reshape rides."""
+        from maggy_tpu import telemetry
+
+        saved = self.saved_meta(step)
+        live = self._template_layout(state_template)
+        if not saved or not live:
+            return
+        diffs = [
+            f"{k}: saved={saved[k]!r} live={live[k]!r}"
+            for k in ("mesh_axes", "num_devices", "n_processes")
+            if saved.get(k) is not None and saved[k] != live[k]
+        ]
+        if diffs:
+            telemetry.get().count("resilience.ckpt_reshards")
+            warnings.warn(
+                f"checkpoint step {step} was saved on a different mesh "
+                f"({'; '.join(diffs)}); resharding every leaf onto the live "
+                "mesh during restore",
                 stacklevel=3,
             )
 
@@ -148,6 +203,7 @@ class Checkpointer:
         )
         last_err: Optional[BaseException] = None
         for i, s in enumerate(candidates):
+            self._check_reshard(s, state_template)
             if expect_meta is not None:
                 self._check_meta(s, expect_meta)
             try:

@@ -157,6 +157,11 @@ class Trainer:
     # axis > 1 (defaults to 2*pp — enough to amortize the 1F1B bubble while
     # staying valid for small test batches); must divide the batch size
     n_microbatches: Optional[int] = None
+    # elastic membership (docs/resilience.md): a MembershipMonitor injected
+    # by the distributed executor. fit polls it at step boundaries — a
+    # pending epoch (or a chaos slice_drop/slice_rejoin) interrupts the loop
+    # with a membership exception the executor's reshape loop catches
+    membership: Optional[Any] = None
 
     def __post_init__(self):
         self._train_step = None
@@ -620,9 +625,66 @@ class Trainer:
         return {
             "mesh_axes": mesh_axes,
             "num_devices": int(self.mesh.size),
+            # world-size provenance: restores compare these against the live
+            # topology and warn-and-reshard instead of silently mis-sharding
+            # when a checkpoint crosses mesh widths (elastic reshape,
+            # pod-size changes)
+            "n_processes": int(jax.process_count()),
             "n_microbatches": self.n_microbatches,
             "dtype": str(getattr(cfg, "dtype", None)) if cfg is not None else None,
         }
+
+    def _membership_check(self, state, step: int, checkpointer, chaos, tel) -> None:
+        """Elastic-membership step-boundary seam (docs/resilience.md).
+
+        Raises one of the membership control-flow exceptions when the mesh
+        must reshape; the distributed executor's elastic loop catches them,
+        negotiates the new view with the driver, and re-enters the train_fn
+        (which resumes from the latest complete checkpoint).
+
+        * A **pending epoch** (another member's event, delivered via the
+          heartbeat RESHAPE reply) and a chaos **slice_rejoin** are
+          graceful: the current step is checkpointed synchronously first,
+          so all members converge on a checkpoint that includes every step
+          taken here and nothing re-runs.
+        * A chaos **slice_drop** is abrupt — the slice's devices (and any
+          state since the last retained checkpoint) are gone, exactly like
+          a real preemption, so nothing is saved: the reshaped run falls
+          back to the last periodic checkpoint.
+        """
+        from maggy_tpu.resilience.membership import (
+            MembershipChanged,
+            SliceLost,
+            SliceRejoin,
+        )
+
+        mem = self.membership
+        event: Optional[BaseException] = None
+        pending = mem.pending_epoch()
+        if pending is not None:
+            event = MembershipChanged(pending)
+        elif chaos is not None:
+            # sim mode hosts every active slice, so any of them may drop
+            # here; a worker-mode process IS one slice and only its own
+            # loss can originate locally
+            self_slice = getattr(mem, "self_slice", None)
+            candidates = mem.active if self_slice is None else (self_slice,)
+            dropped = chaos.slice_drop(candidates, step=step)
+            if dropped is not None:
+                raise SliceLost(dropped, step=step)
+            if self_slice is None:
+                joined = chaos.slice_rejoin(mem.inactive, step=step)
+                if joined is not None:
+                    event = SliceRejoin(joined, step=step)
+        if event is None:
+            return
+        if checkpointer is not None:
+            # the reshape barrier's convergence point: one synchronous save
+            # at the current step (same discipline as the preemption hook)
+            checkpointer.save(step, state, meta=self.checkpoint_meta())
+            checkpointer.wait()
+            tel.count("resilience.reshape_checkpoints")
+        raise event
 
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
         if (
@@ -937,6 +999,14 @@ class Trainer:
         try:
             for i in range(num_steps):  # hot-loop (tools/check_host_sync.py)
                 wd.beat("train.step", detail=step0 + i)
+                if self.membership is not None:
+                    # elastic membership (docs/resilience.md): a pending
+                    # epoch or a chaos slice event interrupts the loop at
+                    # this step boundary; graceful transitions checkpoint
+                    # the current step first so no step re-runs
+                    self._membership_check(
+                        state, step0 + i, checkpointer, chaos, tel
+                    )
                 if chaos is not None:
                     # deterministic fault injection (chaos harness): a
                     # matching kill rule raises WorkerLost here
@@ -1118,9 +1188,15 @@ class TrainContext:
     # assignment (tf_dist_executor.py:138-144); an evaluator is outside the
     # training group and should evaluate checkpoints instead of training
     role: str = "worker"
+    # elastic membership (docs/resilience.md): the worker's MembershipMonitor
+    # and, for multi-slice meshes, the SliceTopology the mesh was built for
+    membership: Any = None
+    topology: Any = None
 
     @classmethod
-    def create(cls, spec_or_preset="fsdp", devices=None, role="worker") -> "TrainContext":
+    def create(
+        cls, spec_or_preset="fsdp", devices=None, role="worker", membership=None
+    ) -> "TrainContext":
         import jax as _jax
 
         from maggy_tpu import util
@@ -1135,6 +1211,61 @@ class TrainContext:
             process_index=_jax.process_index(),
             num_processes=_jax.process_count(),
             role=role,
+            membership=membership,
+        )
+
+    @classmethod
+    def create_sliced(
+        cls,
+        spec_or_preset="fsdp",
+        total_slices: int = 1,
+        active=None,
+        devices=None,
+        role="worker",
+        membership=None,
+    ) -> "TrainContext":
+        """A context over a multi-slice mesh (docs/distributed.md "Slice
+        topology"): the device lease splits into ``total_slices`` contiguous
+        simulated slices, ``active`` (default: all) selects which are in the
+        mesh, and each runs ``spec_or_preset`` internally under an outer
+        ``slice`` data axis. Batch placement spans (slice, data, fsdp) via
+        :func:`maggy_tpu.parallel.sharding.slice_rules`; params never shard
+        over ``slice``, so the gradient sync decomposes into intra-slice
+        reduce-scatter (ICI) + cross-slice all-reduce (DCN). Elastic
+        membership rebuilds this context with the surviving ``active`` set
+        on every epoch change."""
+        import jax as _jax
+
+        from maggy_tpu import util
+        from maggy_tpu.parallel.mesh import make_slice_mesh, slice_device_groups
+        from maggy_tpu.parallel.spec import SliceTopology
+
+        util.enable_compilation_cache()
+        devices = list(devices) if devices else list(_jax.devices())
+        groups = slice_device_groups(total_slices, devices)
+        active = tuple(sorted(active if active is not None else range(total_slices)))
+        if not active:
+            raise ValueError("create_sliced needs at least one active slice")
+        mesh_devices = [d for s in active for d in groups[s]]
+        per_slice = len(groups[0])
+        if isinstance(spec_or_preset, ShardingSpec):
+            spec = (
+                spec_or_preset
+                if spec_or_preset.num_devices == per_slice
+                else spec_or_preset.scaled_to(per_slice)
+            )
+        else:
+            spec = ShardingSpec.preset(spec_or_preset, per_slice)
+        topology = SliceTopology(n_slices=len(active), slice_spec=spec)
+        return cls(
+            mesh=make_slice_mesh(topology, mesh_devices),
+            spec=spec,
+            process_index=_jax.process_index(),
+            num_processes=_jax.process_count(),
+            rules=shd.slice_rules(shd.DEFAULT_RULES),
+            role=role,
+            membership=membership,
+            topology=topology,
         )
 
     def trainer(
@@ -1151,6 +1282,7 @@ class TrainContext:
             loss_fn=loss_fn,
             rules=self.rules,
             n_microbatches=n_microbatches,
+            membership=self.membership,
         )
 
     def shard(self, tree, logical_axes=("batch",)):
