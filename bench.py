@@ -367,6 +367,139 @@ def bench_serving(quick: bool = False):
     }
 
 
+def bench_paging(quick: bool = False):
+    """extra.paging: the paged-KV concurrency-at-fixed-HBM gate
+    (docs/serving.md "Paged KV cache").
+
+    Both engines get the SAME simulated KV budget — ``dense_slots`` full
+    ``max_seq_len`` rows, i.e. ``dense_slots * S/P`` pages. The dense
+    engine can hold ``dense_slots`` requests, full stop; the paged engine
+    may open many more slots because a typical request only touches
+    ``ceil(tokens/P)`` pages. Gates:
+
+    * admissible concurrency (peak resident requests) must be >= 2x the
+      dense slot count — the memory-as-scheduling-resource claim;
+    * paged tok/s within 10% of dense at equal offered load — the
+      indirection must not tax the decode hot loop;
+    * prefix aliasing on a shared-system-prompt workload records
+      pages_shared > 0 (the alias-not-copy counter).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.parallel.sharding import unbox
+    from maggy_tpu.serve import Engine, SamplingParams, Scheduler
+
+    cfg = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+    model = Decoder(cfg)
+    params = unbox(
+        model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    dense_slots = 4
+    page_size = 16
+    pages_budget = dense_slots * (cfg.max_seq_len // page_size)  # equal HBM
+    n_requests = 12 if quick else 24
+    max_new = 8
+    # short requests (prompt 4 + 8 new = 12 tokens -> 1 page of 16): the
+    # typical-length traffic whose headroom paging reclaims
+    prompts = [[1 + (i % 40), 2, 3, 4 + (i % 7)] for i in range(n_requests)]
+
+    def run(paged, num_slots, num_pages=None):
+        engine = Engine(
+            cfg, params, num_slots=num_slots, paged=paged,
+            num_pages=(num_pages + 1) if num_pages else None,
+        )
+        scheduler = Scheduler(engine)
+        scheduler.start()
+        peak = 0
+        try:
+            t0 = time.perf_counter()
+            reqs = [
+                scheduler.submit(p, SamplingParams(max_new=max_new))
+                for p in prompts
+            ]
+            deadline = time.time() + 120
+            while time.time() < deadline and any(
+                r.state not in ("done", "failed") for r in reqs
+            ):
+                peak = max(peak, engine.slots.active_count)
+                time.sleep(0.002)
+            wall = time.perf_counter() - t0
+            done = sum(r.state == "done" for r in reqs)
+            stats = scheduler.stats()
+        finally:
+            scheduler.stop()
+        return {
+            "completed": done,
+            "peak_concurrency": peak,
+            "tok_per_sec": round(done * max_new / wall, 1),
+            "stats": stats,
+        }
+
+    dense = run(False, dense_slots)
+    # speed leg: identical geometry (same slots, same load) so the only
+    # delta is the page-table indirection in the decode hot loop
+    paged_same = run(True, dense_slots)
+    # concurrency leg: same page budget, 4x the slots — admissions are now
+    # bounded by pages, not by row reservations
+    paged = run(True, dense_slots * 4, num_pages=pages_budget)
+
+    # prefix aliasing leg: shared system prompt across every request
+    sys_prompt = list(range(100, 100 + 2 * page_size + 5))
+    engine = Engine(cfg, params, num_slots=8, paged=True)
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        reqs = [
+            scheduler.submit(
+                sys_prompt + [60 + i], SamplingParams(max_new=4)
+            )
+            for i in range(6)
+        ]
+        deadline = time.time() + 60
+        while time.time() < deadline and any(
+            r.state not in ("done", "failed") for r in reqs
+        ):
+            time.sleep(0.005)
+        alias_stats = scheduler.stats()
+    finally:
+        scheduler.stop()
+
+    speed_ratio = (
+        paged_same["tok_per_sec"] / dense["tok_per_sec"]
+        if dense["tok_per_sec"]
+        else None
+    )
+    concurrency_x = paged["peak_concurrency"] / max(1, dense_slots)
+    return {
+        "dense_slots": dense_slots,
+        "page_size": page_size,
+        "pages_budget": pages_budget,
+        "dense_tok_per_sec": dense["tok_per_sec"],
+        "paged_tok_per_sec": paged_same["tok_per_sec"],
+        "paged_budget_tok_per_sec": paged["tok_per_sec"],
+        "speed_ratio": round(speed_ratio, 3) if speed_ratio else None,
+        "dense_peak_concurrency": dense["peak_concurrency"],
+        "paged_peak_concurrency": paged["peak_concurrency"],
+        "concurrency_x": round(concurrency_x, 2),
+        "preemptions": paged["stats"].get("preemptions", 0),
+        "prefix_alias_hits": alias_stats.get("prefix_hits", 0),
+        "pages_aliased": (alias_stats.get("paging") or {}).get(
+            "pages_aliased_total", 0
+        ),
+        "decode_compiles": paged["stats"]["compile_counts"]["decode"],
+        # the gate: >= 2x admissible concurrency at equal simulated HBM,
+        # tok/s within 10%, and aliasing actually sharing pages
+        "gate_concurrency_2x": concurrency_x >= 2.0,
+        "gate_speed_within_10pct": bool(speed_ratio and speed_ratio >= 0.9),
+        "gate_alias_shares_pages": (alias_stats.get("paging") or {}).get(
+            "pages_aliased_total", 0
+        )
+        > 0,
+    }
+
+
 def bench_input_pipeline(quick: bool = False):
     """Host-overlap benchmark (ISSUE 5, docs/performance.md): steps/sec
     through ``Trainer.fit`` with a deliberately slow host loader, prefetch
@@ -956,6 +1089,7 @@ def main():
         trace_overhead_stats = None
         autopilot_stats = None
         elastic_stats = None
+        paging_stats = None
     else:
         asha_stats = bench_asha_trials_per_hour(quick=args.quick)
         try:
@@ -994,6 +1128,10 @@ def main():
             elastic_stats = bench_elastic(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
             elastic_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            paging_stats = bench_paging(quick=args.quick)
+        except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
+            paging_stats = {"error": f"{type(e).__name__}: {e}"}
 
     def rnd(v, digits):
         return None if v is None else round(v, digits)
@@ -1023,6 +1161,7 @@ def main():
             "trace_overhead": trace_overhead_stats,
             "autopilot": autopilot_stats,
             "elastic": elastic_stats,
+            "paging": paging_stats,
             "tuned": tuned or None,
         },
     }

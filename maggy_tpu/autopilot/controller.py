@@ -344,12 +344,19 @@ class SchedulerTarget:
 
     def current(self) -> Dict[str, Any]:
         engine = self.scheduler.engine
-        return {
+        knobs = {
             "serve.num_slots": engine.slots.num_slots,
             "serve.max_queue": self.scheduler.max_queue,
             "serve.async_decode": engine.async_decode,
             "serve.prefix_min": engine.prefix_min,
         }
+        if engine.paged:
+            # page_size is startup-only (recorded for the next launch via
+            # the decision cache); max_pages_per_req is the live memory
+            # lever the planner shrinks before touching num_slots
+            knobs["serve.page_size"] = engine.page_size
+            knobs["serve.max_pages_per_req"] = engine.max_pages_per_req
+        return knobs
 
     def pending(self) -> bool:
         return self.scheduler.reconfigure_pending()
@@ -369,6 +376,12 @@ class SchedulerTarget:
             engine = self.scheduler.engine
             engine.prefix_min = max(1, int(value))
             engine.prefix_index.min_len = engine.prefix_min
+            return True
+        if knob == "serve.max_pages_per_req":
+            engine = self.scheduler.engine
+            if not engine.paged:
+                return False
+            engine.set_max_pages_per_req(int(value))
             return True
         return False
 

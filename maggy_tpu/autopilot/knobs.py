@@ -40,6 +40,10 @@ FLASH_TILE_CHOICES = (128, 256, 512, 1024)
 # (kept literal here so the registry stays stdlib-importable)
 REMAT_POLICY_CHOICES = (None, "nothing", "dots", "dots_attn")
 
+# paged KV cache page sizes (tokens): powers of two that divide every
+# supported max_seq_len; the engine snaps incompatible values down
+PAGE_SIZE_CHOICES = (8, 16, 32, 64, 128)
+
 
 @dataclasses.dataclass(frozen=True)
 class Knob:
@@ -128,6 +132,18 @@ KNOBS = {
         Knob(
             "serve.prefix_min", "int", "serve", True,
             "minimum shared-prefix length for KV reuse",
+            lo=1, hi=65536,
+        ),
+        Knob(
+            "serve.page_size", "choice", "serve", False,
+            "paged KV cache page size in tokens (startup-only: the page "
+            "pool layout is baked into the compiled decode program)",
+            choices=PAGE_SIZE_CHOICES,
+        ),
+        Knob(
+            "serve.max_pages_per_req", "int", "serve", True,
+            "cap on KV pages one request may hold; shrunk FIRST when "
+            "memory-bound (before sacrificing num_slots concurrency)",
             lo=1, hi=65536,
         ),
         # ---- fleet router (applied by the Router)

@@ -216,6 +216,19 @@ class Planner:
             if current.get("serve.async_decode") is False:
                 moves.append(Move("serve.async_decode", True, diag.reason))
         elif diag.bottleneck == "memory_bound":
+            # paged engines shrink the per-request page cap FIRST: it
+            # bounds worst-case footprint without sacrificing concurrency;
+            # cutting num_slots is the blunt fallback (docs/serving.md
+            # "Paged KV cache")
+            cap = current.get("serve.max_pages_per_req")
+            if cap and int(cap) > 1:
+                moves.append(
+                    Move(
+                        "serve.max_pages_per_req",
+                        _shrink(KNOBS["serve.max_pages_per_req"], cap),
+                        diag.reason,
+                    )
+                )
             cur = current.get("serve.num_slots")
             if cur and int(cur) > 1:
                 moves.append(

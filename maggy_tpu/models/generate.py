@@ -102,12 +102,18 @@ def _sample(last, rng, temperature: float, top_k: int):
     return jax.random.categorical(sub, scaled, axis=-1), rng
 
 
-def cache_shardings(mesh, abstract_cache, rules=None):
+def cache_shardings(mesh, abstract_cache, rules=None, paged: bool = False):
     """NamedShardings for a decode KV cache: batch over (data, fsdp), KV heads
     over tensor when divisible — so tensor-parallel decode holds 1/tp of each
     cache instead of a full replica (round-1 verdict weak #7). Cache leaves
     are ``[..., B, S, Kh, Dh]`` (a leading layer axis when scanned); anything
     smaller (the write index) replicates.
+
+    ``paged=True`` (``DecoderConfig.paged`` caches): K/V leaves are page
+    pools ``[..., N, P, Kh, Dh]`` with NO batch axis — any row may gather
+    any page, so the page axis must stay whole per shard; only the KV-head
+    axis shards (tensor). The page table ``[..., B, max_pages]`` is tiny
+    and read by every shard — replicated like the index.
     """
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -121,13 +127,15 @@ def cache_shardings(mesh, abstract_cache, rules=None):
     def leaf(path, s):
         # the per-row write index [(L,) B] is tiny and read by every shard —
         # replicate (it would otherwise pattern-match the seg-track branch)
-        if "index" in jax.tree_util.keystr(path):
+        ks = jax.tree_util.keystr(path)
+        if "index" in ks or "pages" in ks:
             return NamedSharding(mesh, PartitionSpec())
         if s.ndim >= 4:
             kv = AXIS_TENSOR if (tp > 1 and s.shape[-2] % tp == 0) else None
             lead = (None,) * (s.ndim - 4)
+            first = None if paged else batch_axes
             return NamedSharding(
-                mesh, PartitionSpec(*lead, batch_axes, None, kv, None)
+                mesh, PartitionSpec(*lead, first, None, kv, None)
             )
         if s.ndim >= 2:
             # the packed segment-id track [(L,) B, S]: batch-sharded like K/V
@@ -160,7 +168,9 @@ def init_cache(decode_model, prompt: jax.Array, mesh=None, rules=None,
     )["cache"]
     if mesh is None:
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract)
-    shardings = cache_shardings(mesh, abstract, rules)
+    shardings = cache_shardings(
+        mesh, abstract, rules, paged=getattr(decode_model.cfg, "paged", False)
+    )
     zeros = jax.jit(
         lambda: jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), abstract),
         out_shardings=shardings,

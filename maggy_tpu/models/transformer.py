@@ -118,6 +118,16 @@ class DecoderConfig:
     # decode=True switches attention to the KV-cache incremental path
     # (build via `dataclasses.replace(cfg, decode=True)`; params are identical)
     decode: bool = False
+    # paged=True (decode only) stores K/V in a flat pool of `num_pages`
+    # fixed-size pages instead of [B, max_seq_len] rows; a per-row page
+    # table (cache variable "pages", [B, max_seq_len/page_size] int32,
+    # host-managed by the serve engine's block allocator) maps logical
+    # positions to physical pages. Decouples batch width from sequence
+    # reservation — the enabler for paged serving (docs/serving.md "Paged
+    # KV cache"). The dense decode path is unchanged when False.
+    paged: bool = False
+    page_size: int = 64
+    num_pages: int = 0
     # KV-cache read chunk: decode attends over ceil(written/chunk) chunks of
     # the cache instead of all max_seq_len slots — HBM traffic (the decode
     # bottleneck, ~4x off roofline per BENCH_NOTES r1) tracks the ACTUAL
@@ -146,6 +156,22 @@ class DecoderConfig:
             raise ValueError(
                 f"remat_policy must be one of {sorted(REMAT_POLICIES)}"
             )
+        if self.paged:
+            if not self.decode:
+                raise ValueError("paged=True requires decode=True")
+            p = self.page_size
+            if p < 1 or (p & (p - 1)):
+                raise ValueError(f"page_size must be a power of two, got {p}")
+            if self.max_seq_len % p:
+                raise ValueError(
+                    f"page_size ({p}) must divide max_seq_len "
+                    f"({self.max_seq_len})"
+                )
+            if self.num_pages < 2:
+                raise ValueError(
+                    "paged=True needs num_pages >= 2 (page 0 is the "
+                    f"reserved scratch page), got {self.num_pages}"
+                )
         object.__setattr__(self, "ablated", frozenset(self.ablated))
         _parse_ablated(self.ablated, self.n_layers)  # validate eagerly
 
@@ -382,8 +408,20 @@ class Attention(nn.Module):
         (maggy_tpu/serve), where one compiled step decodes requests admitted
         at different times. Lockstep callers (generate_cached, prefill) keep
         identical values in every row and reproduce the old scalar
-        semantics exactly."""
+        semantics exactly.
+
+        Paged mode (``cfg.paged``; docs/serving.md "Paged KV cache")
+        replaces the ``[B, max_seq_len]`` row reservation with a flat page
+        pool plus per-row page-table indirection — same math, same masks,
+        storage decoupled from batch width. The packed ``segment_ids``
+        track is a dense-path feature (the serve engine never packs)."""
         cfg = self.cfg
+        if cfg.paged:
+            if segment_ids is not None or self.has_variable("cache", "seg"):
+                raise NotImplementedError(
+                    "paged decode does not support packed segment_ids"
+                )
+            return self._paged_cached_attention(q, k, v, positions)
         b, t, kh, hd = k.shape
         k_cache = self.variable(
             "cache", "k",
@@ -478,6 +516,110 @@ class Attention(nn.Module):
                     & (kpos[None, None, None, :] < w_row)
                     & (seg_c[:, None, None, :] == seg_q[:, None, :, None])
                 )
+            return ops_attn.online_block_update(
+                carry,
+                q,
+                ops_attn.repeat_kv(k_c, h),
+                ops_attn.repeat_kv(v_c, h),
+                mask,
+                scale,
+            )
+
+        carry = ops_attn.init_carry(b, h, t, hd)
+        acc, _, l = jax.lax.fori_loop(0, n_valid, body, carry)
+        return ops_attn.finalize(acc, l, q.dtype)
+
+    def _paged_cached_attention(self, q, k, v, positions):
+        """Paged KV cache: K/V live in a flat pool of ``num_pages`` pages of
+        ``page_size`` slots (``[N, P, Kh, Dh]``) and each batch row maps its
+        logical positions to physical pages through a ``[B, max_seq_len/P]``
+        int32 page-table row — the vLLM/Pallas paged-attention layout
+        expressed at the XLA level. The table is a cache variable this
+        module only READS; the serve engine's host-side block allocator
+        owns it (allocation, prefix aliasing, release all happen by editing
+        table rows, never by moving K/V bytes).
+
+        Writes scatter each new token to ``(table[b, pos // P], pos % P)``.
+        A released/inactive row's table is zeroed and its index clamped, so
+        masked lockstep writes land on the reserved scratch page 0 —
+        garbage by design, never read as valid.
+
+        Reads run the SAME chunked online-softmax loop as the dense path,
+        except each chunk is materialized by gathering ``chunk/P`` pages
+        into a contiguous block (one gather per chunk — the XLA analogue of
+        the paged-attention kernel's per-page DMA batch) instead of a
+        contiguous ``dynamic_slice``. Chunk token count, masks and update
+        order are identical to the dense path whenever ``page_size``
+        divides the effective chunk, so paged decode output is
+        BIT-identical to dense decode — the byte-parity contract
+        tests/test_paged_kv.py enforces."""
+        cfg = self.cfg
+        b, t, kh, hd = k.shape
+        P = cfg.page_size
+        S = cfg.max_seq_len
+        max_pages = S // P
+        k_pool = self.variable(
+            "cache", "k",
+            lambda: jnp.zeros((cfg.num_pages, P, kh, hd), cfg.dtype),
+        )
+        v_pool = self.variable(
+            "cache", "v",
+            lambda: jnp.zeros((cfg.num_pages, P, kh, hd), cfg.dtype),
+        )
+        pages = self.variable(
+            "cache", "pages", lambda: jnp.zeros((b, max_pages), jnp.int32)
+        )
+        index = self.variable(
+            "cache", "index", lambda: jnp.zeros((b,), jnp.int32)
+        )
+        idx = index.value  # [B] per-row write offsets (logical positions)
+        pt = pages.value  # [B, max_pages] logical page -> physical page
+
+        # scatter this chunk's K/V through the page table: token j of row b
+        # lands at (pt[b, (idx+j)//P], (idx+j)%P). Distinct live rows own
+        # distinct pages, so scatter indices never collide except on the
+        # scratch page (masked rows), whose content is garbage by contract.
+        pos_w = idx[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        page_slot = jnp.clip(pos_w // P, 0, max_pages - 1)
+        phys = jnp.take_along_axis(pt, page_slot, axis=1)  # [B, t]
+        off = pos_w % P
+        k_all = k_pool.value.at[phys, off].set(k.astype(cfg.dtype))
+        v_all = v_pool.value.at[phys, off].set(v.astype(cfg.dtype))
+        k_pool.value = k_all
+        v_pool.value = v_all
+        index.value = idx + t
+
+        # identical chunk geometry to the dense path (bit parity): token
+        # chunks of the dense size, materialized as cpp-page gathers
+        chunk = min(cfg.decode_chunk, S)
+        while S % chunk:
+            chunk //= 2
+        if chunk < 16:
+            chunk = S
+        cpp = max(1, chunk // P)  # pages per chunk
+        tok_chunk = cpp * P
+        n_chunks = max_pages // cpp
+        h = q.shape[2]
+        scale = 1.0 / (hd**0.5)
+        written = idx + t  # [B] per-row logical lengths after this write
+        n_valid = jnp.minimum(
+            (jnp.max(written) + tok_chunk - 1) // tok_chunk, n_chunks
+        )
+
+        def body(ci, carry):
+            pt_c = jax.lax.dynamic_slice(
+                pt, (jnp.int32(0), ci * cpp), (b, cpp)
+            )  # [B, cpp] physical page ids for this chunk
+            k_c = k_all[pt_c].reshape(b, tok_chunk, kh, hd)
+            v_c = v_all[pt_c].reshape(b, tok_chunk, kh, hd)
+            kpos = ci * tok_chunk + jnp.arange(tok_chunk)
+            w_row = written[:, None, None, None]  # per-row valid-key bound
+            # causal over logical positions + written bound: exactly the
+            # dense unpacked mask (unallocated table entries point at the
+            # scratch page; their kpos >= written, so they are masked)
+            mask = (
+                kpos[None, None, None, :] <= positions[:, None, :, None]
+            ) & (kpos[None, None, None, :] < w_row)
             return ops_attn.online_block_update(
                 carry,
                 q,

@@ -151,6 +151,14 @@ def _telemetry_lines(status: dict, width: int) -> list:
             parts.append(f"drain {g['serve.drain_ms']:.1f}ms")
         if "serve.decode_retraces" in g:
             parts.append(f"compiles {g['serve.decode_retraces']:.0f}")
+        # paged KV cache (docs/serving.md "Paged KV cache")
+        if "serve.pages_free" in g:
+            parts.append(
+                f"pages {g['serve.pages_free']:.0f} free"
+                f"/{g.get('serve.pages_shared', 0):.0f} shared"
+            )
+        if "serve.handoff_ms" in g:
+            parts.append(f"handoff {g['serve.handoff_ms']:.1f}ms")
         if "fleet.healthy_replicas" in g:
             parts.append(f"healthy {g['fleet.healthy_replicas']:.0f}")
         c0 = snap.get("counters") or {}
@@ -159,6 +167,8 @@ def _telemetry_lines(status: dict, width: int) -> list:
                 f"prefix {c0['serve.prefix_hits']}/"
                 f"{c0.get('serve.prefix_tokens_saved', 0)}tok"
             )
+        if c0.get("serve.preemptions"):
+            parts.append(f"preempt {c0['serve.preemptions']}")
         # autotuner progress (maggy_tpu/tune): candidate grid, AOT prunes,
         # and the best measured step time so far
         if "tune.candidates" in g:
@@ -228,6 +238,29 @@ def _latency_parts(sv: dict) -> list:
             f"slo {100 * sv['slo_attainment']:.1f}%"
             f" ({sv.get('slo_ok', 0)}/{sv.get('slo_ok', 0) + sv.get('slo_miss', 0)})"
         )
+    return parts
+
+
+def _paging_parts(sv: dict) -> list:
+    """Paged-KV summary for a serve/fleet SSTATS dict: pool occupancy,
+    sharing, and preemptions (docs/serving.md "Paged KV cache"). The
+    single-engine dict nests under ``paging``; the fleet aggregate is
+    flat (summed over paged replicas)."""
+    paging = sv.get("paging") or {}
+    parts = []
+    if paging.get("paged"):
+        parts.append(
+            f"pages {paging.get('pages_free', 0)}"
+            f"/{paging.get('pages_total', 0)} free"
+        )
+        if paging.get("pages_shared"):
+            parts.append(f"{paging['pages_shared']} shared")
+    elif sv.get("pages_total"):
+        parts.append(f"pages {sv.get('pages_free', 0)}/{sv['pages_total']} free")
+        if sv.get("pages_shared"):
+            parts.append(f"{sv['pages_shared']} shared")
+    if sv.get("preemptions"):
+        parts.append(f"preempt {sv['preemptions']}")
     return parts
 
 
@@ -334,6 +367,11 @@ def render_status(status: dict, width: int = 78) -> str:
             f"  requeued={routing.get('requeued', 0)}"
             f"  shed={routing.get('shed', 0)}"
             f"  respawned={routing.get('respawned', 0)}"
+            + (
+                f"  handoffs={routing.get('handoffs', 0)}"
+                if routing.get("prefilled")
+                else ""
+            )
             + (f"  {elapsed:.0f}s" if elapsed is not None else "")
         )
         agg = []
@@ -342,6 +380,7 @@ def render_status(status: dict, width: int = 78) -> str:
                 f"prefix hits {sv['prefix_hits']} "
                 f"({sv.get('prefix_tokens_saved', 0)} tok saved)"
             )
+        agg.extend(_paging_parts(sv))
         agg.extend(_latency_parts(sv))
         lines.extend(_wrap_parts(agg, width))
         lines.extend(line[:width] for line in _autopilot_line(sv))
@@ -353,9 +392,12 @@ def render_status(status: dict, width: int = 78) -> str:
             tag = {"up": "up", "quarantined": "QUAR", "dead": "DEAD"}.get(
                 row.get("state"), row.get("state", "?")
             )
+            role = row.get("role")
             lines.append(
                 (
-                    f"  r{row.get('replica', '?')} [{tag:>4}] slots {bar}"
+                    f"  r{row.get('replica', '?')} [{tag:>4}]"
+                    + (f" {role}" if role and role != "any" else "")
+                    + f" slots {bar}"
                     f"  queue={row.get('queue_depth', 0)}"
                     f"  done={row.get('requests_done', 0)}"
                     f"  prefix={row.get('prefix_hits', 0)}"
@@ -383,6 +425,7 @@ def render_status(status: dict, width: int = 78) -> str:
         parts = [f"{sv.get('tokens_out', 0):,} tokens"]
         if sv.get("tokens_per_sec"):
             parts.append(f"{sv['tokens_per_sec']:,.0f} tok/s")
+        parts.extend(_paging_parts(sv))
         parts.extend(_latency_parts(sv))
         compiles = (sv.get("compile_counts") or {}).get("decode")
         if compiles is not None:

@@ -22,6 +22,11 @@ router over N of these stacks behind the same verb set
 
 from maggy_tpu.serve.client import ServeClient  # noqa: F401
 from maggy_tpu.serve.engine import Engine  # noqa: F401
+from maggy_tpu.serve.paging import (  # noqa: F401
+    BlockAllocator,
+    OutOfPagesError,
+    PageTable,
+)
 from maggy_tpu.serve.prefix import PrefixIndex  # noqa: F401
 from maggy_tpu.serve.request import Request, SamplingParams  # noqa: F401
 from maggy_tpu.serve.scheduler import Scheduler  # noqa: F401
@@ -29,7 +34,10 @@ from maggy_tpu.serve.server import ServeServer  # noqa: F401
 from maggy_tpu.serve.slots import SlotManager  # noqa: F401
 
 __all__ = [
+    "BlockAllocator",
     "Engine",
+    "OutOfPagesError",
+    "PageTable",
     "PrefixIndex",
     "Scheduler",
     "ServeServer",

@@ -102,7 +102,25 @@ def main(argv=None) -> int:
                         help="fleet behavior when projection exceeds the SLO")
     parser.add_argument("--max-restarts", type=int, default=1,
                         help="fleet-wide replica respawn budget")
+    parser.add_argument("--paged", dest="paged", action="store_true",
+                        default=None,
+                        help="paged KV cache (default on; docs/serving.md)")
+    parser.add_argument("--no-paged", dest="paged", action="store_false",
+                        help="dense row-per-slot KV cache fallback")
+    parser.add_argument("--page-size", type=int,
+                        help="KV page size in tokens (power of two dividing "
+                             "max_seq_len; default 16)")
+    parser.add_argument("--num-pages", type=int,
+                        help="KV page pool size; default reserves the dense "
+                             "equivalent (slots x max_seq_len/page_size + 1)")
+    parser.add_argument("--prefill-replicas", type=int, default=0,
+                        help="disaggregated fleet: N extra prefill-only "
+                             "replicas; prompts prefill there and the KV "
+                             "pages hand off to decode replicas "
+                             "(docs/fleet.md)")
     args = parser.parse_args(argv)
+    if args.prefill_replicas and args.replicas < 1:
+        raise SystemExit("--prefill-replicas needs at least one decode replica")
 
     from maggy_tpu.models import Decoder
     from maggy_tpu.serve import Engine, Scheduler, ServeServer
@@ -149,7 +167,7 @@ def main(argv=None) -> int:
     tel = None
     if args.exp_dir:
         tel = worker_telemetry("serve", args.exp_dir, role="serve")
-    if args.replicas > 1:
+    if args.replicas > 1 or args.prefill_replicas > 0:
         from maggy_tpu.serve.fleet import ReplicaSpec, launch_fleet
 
         tel_factory = None
@@ -160,6 +178,8 @@ def main(argv=None) -> int:
         spec = ReplicaSpec(
             cfg, params, num_slots=args.slots, mesh=mesh,
             telemetry_factory=tel_factory,
+            paged=args.paged, page_size=args.page_size,
+            num_pages=args.num_pages,
         )
         server = launch_fleet(
             spec,
@@ -170,12 +190,18 @@ def main(argv=None) -> int:
             admission=args.admission,
             max_restarts=args.max_restarts,
             telemetry_recorder=tel,
+            prefill_replicas=args.prefill_replicas,
         )
         host, port = server.start(host=args.host, port=args.port)
-        what = f"fleet router ({args.replicas} replicas)"
+        what = f"fleet router ({args.replicas} replicas"
+        if args.prefill_replicas:
+            what += f" + {args.prefill_replicas} prefill"
+        what += ")"
     else:
         engine = Engine(
-            cfg, params, num_slots=args.slots, mesh=mesh, telemetry_recorder=tel
+            cfg, params, num_slots=args.slots, mesh=mesh,
+            telemetry_recorder=tel, paged=args.paged,
+            page_size=args.page_size, num_pages=args.num_pages,
         )
         scheduler = Scheduler(engine, slo_ttft_ms=args.slo_ttft_ms)
         server = ServeServer(scheduler, secret=args.secret, name=args.name)

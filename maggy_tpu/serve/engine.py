@@ -39,6 +39,21 @@ every cached K/V projection, is identical), only the suffix is prefilled
 usual per-row index pin. Outputs are byte-identical to a full prefill;
 ``prefix_hits`` / ``prefix_tokens_saved`` counters prove the saved work.
 
+**Paged KV cache (default; docs/serving.md "Paged KV cache"):** with
+``paged=True`` the batch cache is a flat pool of fixed-size pages plus a
+per-slot page-table row inside the ONE compiled decode program
+(``models/transformer.py::_paged_cached_attention``), and a host-side
+:class:`~maggy_tpu.serve.paging.BlockAllocator` owns the physical pages. A
+request holds ``ceil(tokens/page_size)`` pages instead of a full
+``max_seq_len`` row, so slot count decouples from HBM; prefix reuse becomes
+*aliasing* ref-counted pages (zero KV copies for the shared full pages —
+only the partial boundary page is copied, through the same one-program
+admit) and eviction/preemption is a host-side page-list edit. Pages are
+copy-on-write by construction: decode only ever writes past ``plen`` into
+privately-owned tail pages, so a shared page is never written in place.
+``paged=False`` (or ``MAGGY_TPU_SERVE_PAGED=0``) keeps the dense
+row-per-slot path — outputs are byte-identical either way.
+
 **Async decode (default; docs/performance.md):** ``step()`` dispatches
 decode step ``i+1`` BEFORE host-reading step ``i``'s sampled tokens.
 Continuing slots take their input token straight from the in-flight device
@@ -59,7 +74,7 @@ import contextlib
 import dataclasses
 import os
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +83,7 @@ import numpy as np
 from maggy_tpu import telemetry
 from maggy_tpu.exceptions import BadArgumentsError
 from maggy_tpu.models.generate import init_cache, prefill
+from maggy_tpu.serve.paging import BlockAllocator, OutOfPagesError, PageTable
 from maggy_tpu.serve.prefix import PrefixIndex
 from maggy_tpu.serve.request import Request
 from maggy_tpu.serve.slots import SlotManager, SlotOccupiedError
@@ -79,6 +95,9 @@ TOPK_CAP = 64
 
 # smallest prefill bucket; prompts shorter than this share one compile
 MIN_PREFILL_BUCKET = 8
+
+# default KV page size (tokens) for the paged cache; must divide max_seq_len
+DEFAULT_PAGE_SIZE = 16
 
 
 def _sample_one(logits, temp, top_k, key):
@@ -127,6 +146,10 @@ class Engine:
         async_decode: Optional[bool] = None,
         prefix_reuse: Optional[bool] = None,
         prefix_min: Optional[int] = None,
+        paged: Optional[bool] = None,
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        max_pages_per_req: Optional[int] = None,
     ):
         from maggy_tpu.models import Decoder
 
@@ -165,9 +188,61 @@ class Engine:
         self.prefix_tokens_saved = 0
         self.prefill_calls = 0  # full (from-scratch) prefills
 
+        # ---- paged KV cache (docs/serving.md "Paged KV cache")
+        if paged is None:
+            paged = os.environ.get(
+                "MAGGY_TPU_SERVE_PAGED", "1"
+            ).lower() not in ("0", "false", "off")
+        self.paged = bool(paged)
+        if page_size is None:
+            page_size = int(
+                os.environ.get("MAGGY_TPU_SERVE_PAGE_SIZE", DEFAULT_PAGE_SIZE)
+            )
+        self.page_size = max(1, int(page_size))
+        while self.max_seq_len % self.page_size:
+            # any max_seq_len is served: fall back to the largest divisor
+            self.page_size //= 2
+        self.pages_per_row = self.max_seq_len // self.page_size
+        # pool defaults to the dense capacity (num_slots full rows) plus the
+        # reserved scratch page; pass num_pages to run UNDER the dense
+        # budget — that is the whole point (bench.py extra.paging)
+        self._num_pages_explicit = num_pages is not None
+        self.num_pages = (
+            int(num_pages)
+            if num_pages is not None
+            else num_slots * self.pages_per_row + 1
+        )
+        self.max_pages_per_req = min(
+            self.pages_per_row,
+            int(max_pages_per_req)
+            if max_pages_per_req is not None
+            else self.pages_per_row,
+        )
+        self.pages_aliased = 0  # cumulative pages shared instead of copied
+        self._last_page_gauges = None
+        if self.paged:
+            self.paged_model = Decoder(
+                dataclasses.replace(
+                    cfg,
+                    decode=True,
+                    paged=True,
+                    page_size=self.page_size,
+                    num_pages=self.num_pages,
+                )
+            )
+            self.allocator = BlockAllocator(self.num_pages, self.page_size)
+            self.page_table = PageTable(num_slots, self.pages_per_row)
+        else:
+            self.paged_model = None
+            self.allocator = None
+            self.page_table = None
+        # the model behind the batch decode step (prefill always runs the
+        # dense single-row variant; paged admission re-pages its output)
+        self._batch_model = self.paged_model or self.decode_model
+
         B = num_slots
         dummy = jnp.zeros((B, 1), jnp.int32)
-        self.cache = init_cache(self.decode_model, dummy, mesh=mesh)
+        self.cache = init_cache(self._batch_model, dummy, mesh=mesh)
         # decode applies run under the mesh so activation constraints and the
         # sharded cache resolve; mesh-free (single chip / CPU) costs nothing
         self._ctx = (lambda: mesh) if mesh is not None else contextlib.nullcontext
@@ -194,6 +269,8 @@ class Engine:
         self._admit_jit = jax.jit(self._admit_impl)
         self._prefill_jit = jax.jit(self._prefill_impl)
         self._prefix_admit_jit = jax.jit(self._prefix_admit_impl)
+        self._paged_admit_jit = jax.jit(self._paged_admit_impl)
+        self._paged_prefix_admit_jit = jax.jit(self._paged_prefix_admit_impl)
         # abstract single-row cache: the leaf-shape template the prefix-admit
         # extraction uses to find each leaf's batch axis (mirrors _admit_impl)
         self._row_abstract = jax.eval_shape(
@@ -205,9 +282,13 @@ class Engine:
 
     # ------------------------------------------------------------- jit bodies
 
-    def _prefill_impl(self, params, tokens, plen, temp, top_k, key_data):
+    def _prefill_impl(self, params, tokens, plen, temp, top_k, key_data, gen0):
         """tokens [1, Pp] (bucket-padded), plen scalar — returns the filled
-        single-row cache and the first sampled token (generated index 0)."""
+        single-row cache and the first sampled token. ``gen0`` is the
+        generated-token index the sample resumes at: 0 for a fresh request,
+        the retained token count for a preempted request being re-admitted
+        from prompt+generated tokens (the PRNG chain continues exactly
+        where decode would have — docs/serving.md "Preemption")."""
         self._prefill_traces += 1
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
@@ -215,8 +296,8 @@ class Engine:
         logits, cache = prefill(self.decode_model, params, tokens, positions)
         last = jax.lax.dynamic_index_in_dim(
             logits[0], plen - 1, axis=0, keepdims=False
-        )  # [V] — the logit that predicts the first generated token
-        key = jax.random.fold_in(jax.random.wrap_key_data(key_data), 0)
+        )  # [V] — the logit that predicts the next generated token
+        key = jax.random.fold_in(jax.random.wrap_key_data(key_data), gen0)
         tok = _sample_one(last, temp, top_k, key)
         return cache, tok
 
@@ -261,6 +342,7 @@ class Engine:
         suffix_tokens,
         start,
         plen,
+        gen0,
         temp,
         top_k,
         key_pair,
@@ -310,10 +392,120 @@ class Engine:
         last = jax.lax.dynamic_index_in_dim(
             logits[0], plen - start - 1, axis=0, keepdims=False
         )  # [V] — the logit at overall position plen-1, same as full prefill
-        key = jax.random.fold_in(jax.random.wrap_key_data(key_pair), 0)
+        key = jax.random.fold_in(jax.random.wrap_key_data(key_pair), gen0)
         tok = _sample_one(last, temp, top_k, key)
         cache, key_data = self._admit_impl(
             cache, mutated["cache"], key_data, dst_slot, plen, key_pair
+        )
+        return cache, key_data, tok
+
+    # ------------------------------------------------------ paged jit bodies
+
+    def _paged_admit_impl(
+        self, cache, row_cache, key_data, write_ids, slot, plen, key_pair
+    ):
+        """Write a prefilled dense single-row cache into the page pool.
+
+        ``write_ids`` is a ``[pages_per_row]`` int32 host-built map: entry
+        ``j`` is the physical page that receives the row's logical page
+        ``j``, or the scratch page 0 for pages this request does not own —
+        prefix-ALIASED pages (their content is already correct and shared;
+        writing them would violate copy-on-write) and pages past the
+        prompt. Scratch writes are garbage by contract; real pages receive
+        a FULL page of row content, so the write is idempotent against any
+        masked garbage an in-flight async step may have scattered there."""
+        self._admit_traces += 1
+        row = {
+            jax.tree_util.keystr(path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(row_cache)[0]
+        }
+
+        def write(path, leaf):
+            ks = jax.tree_util.keystr(path)
+            if "pages" in ks:
+                return leaf  # host-owned: the engine pushes the table
+            if "index" in ks:
+                b = leaf.shape[-1]
+                return jnp.where(jnp.arange(b) == slot, plen, leaf)
+            rl = row[ks]  # [(L,) 1, S, Kh, Dh] dense row
+            P = leaf.shape[-3]
+            if leaf.ndim == 5:  # scanned pool [L, N, P, Kh, Dh]
+                pages = rl[:, 0].reshape(
+                    rl.shape[0], -1, P, *rl.shape[3:]
+                )
+                return leaf.at[:, write_ids].set(pages.astype(leaf.dtype))
+            pages = rl[0].reshape(-1, P, *rl.shape[3:])
+            return leaf.at[write_ids].set(pages.astype(leaf.dtype))
+
+        cache = jax.tree_util.tree_map_with_path(write, cache)
+        key_data = jax.lax.dynamic_update_slice(
+            key_data, key_pair[None, :], (slot, jnp.int32(0))
+        )
+        return cache, key_data
+
+    def _paged_prefix_admit_impl(
+        self,
+        params,
+        cache,
+        key_data,
+        src_row_ids,
+        write_ids,
+        dst_slot,
+        suffix_tokens,
+        start,
+        plen,
+        gen0,
+        temp,
+        top_k,
+        key_pair,
+    ):
+        """Paged admit-from-prefix, one compiled program per suffix bucket.
+
+        The source request's page-table row (``src_row_ids``) gathers its
+        pool pages back into a dense single-row workspace whose index is
+        pinned to ``start``; ONLY the suffix runs through the model
+        (positions ``start..plen``), and the mutated row is re-paged via
+        ``write_ids`` — which routes the shared full pages to scratch, so
+        the aliased pages are never rewritten (zero KV copies for the
+        shared prefix; the partial boundary page is the one copy, carried
+        through the workspace). The persistent sharing is pure host state:
+        the allocator ref-counts the aliased page ids into the new
+        request's page list before this program runs."""
+        self._prefix_traces += 1
+        pooled = {
+            jax.tree_util.keystr(path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]
+        }
+
+        def extract(path, row_ab):
+            ks = jax.tree_util.keystr(path)
+            if "index" in ks:
+                return jnp.full(row_ab.shape, start, row_ab.dtype)
+            leaf = pooled[ks]
+            if leaf.ndim == 5:  # scanned pool [L, N, P, Kh, Dh]
+                return leaf[:, src_row_ids].reshape(row_ab.shape)
+            return leaf[src_row_ids].reshape(row_ab.shape)
+
+        row_cache = jax.tree_util.tree_map_with_path(
+            extract, self._row_abstract
+        )
+        positions = (start + jnp.arange(suffix_tokens.shape[1], dtype=jnp.int32))[
+            None, :
+        ]
+        logits, mutated = self.decode_model.apply(
+            {"params": params, "cache": row_cache},
+            suffix_tokens,
+            positions,
+            mutable=["cache"],
+        )
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], plen - start - 1, axis=0, keepdims=False
+        )
+        key = jax.random.fold_in(jax.random.wrap_key_data(key_pair), gen0)
+        tok = _sample_one(last, temp, top_k, key)
+        cache, key_data = self._paged_admit_impl(
+            cache, mutated["cache"], key_data, write_ids, dst_slot, plen,
+            key_pair,
         )
         return cache, key_data, tok
 
@@ -342,7 +534,7 @@ class Engine:
         ``host_tokens``."""
         self._decode_traces += 1
         tokens = jnp.where(use_prev, prev_tokens, host_tokens)
-        logits, mutated = self.decode_model.apply(
+        logits, mutated = self._batch_model.apply(
             {"params": params, "cache": cache},
             tokens[:, None],
             pos[:, None],
@@ -382,31 +574,70 @@ class Engine:
 
         Returns ``(slot, first_token)`` — the first token IS the TTFT token,
         produced here, not in the decode loop. Raises
-        :class:`SlotOccupiedError` when no slot is free and
-        :class:`BadArgumentsError` when the request cannot fit.
+        :class:`SlotOccupiedError` when no slot is free,
+        :class:`OutOfPagesError` when the paged pool cannot hold the prompt
+        (the scheduler's cue to wait or preempt — never a failed request),
+        and :class:`BadArgumentsError` when the request cannot fit at all.
+
+        A request carrying generated tokens is a PREEMPTED request being
+        re-admitted: the effective prompt is prompt+tokens and the sampling
+        chain resumes at ``gen0 = len(tokens)``, so the continued stream is
+        byte-identical to one that was never preempted.
         """
-        plen = len(request.prompt)
+        prompt = [int(t) for t in request.prompt] + [
+            int(t) for t in request.tokens
+        ]
+        gen0 = len(request.tokens)
+        plen = len(prompt)
         p = request.params
-        if plen < 1:
+        if len(request.prompt) < 1:
             raise BadArgumentsError("empty prompt")
-        if plen + p.max_new > self.max_seq_len:
+        if len(request.prompt) + p.max_new > self.max_seq_len:
             raise BadArgumentsError(
-                f"prompt ({plen}) + max_new ({p.max_new}) exceeds "
-                f"max_seq_len ({self.max_seq_len})"
+                f"prompt ({len(request.prompt)}) + max_new ({p.max_new}) "
+                f"exceeds max_seq_len ({self.max_seq_len})"
             )
         if not self.slots.free_slots():
             raise SlotOccupiedError("no free slot")
+        if self.paged:
+            worst = -(-(len(request.prompt) + p.max_new) // self.page_size)
+            cap = min(self.max_pages_per_req, self.allocator.pages_total)
+            if worst > cap:
+                raise BadArgumentsError(
+                    f"request needs up to {worst} pages "
+                    f"(page_size {self.page_size}) > cap {cap} "
+                    "(max_pages_per_req / pool size)"
+                )
 
         key_pair = jnp.asarray(_base_key_data(p.seed))
         slot = self.slots.free_slots()[0]
-        reuse = self._match_prefix(request.prompt)
+        reuse = self._match_prefix(prompt)
+        if self.paged:
+            tok = self._admit_paged(prompt, p, slot, gen0, reuse, key_pair)
+        else:
+            tok = self._admit_dense(prompt, p, slot, gen0, reuse, key_pair)
+        # claim the slot only after every device op succeeded — a throwing
+        # prefill/admit must not leak an occupied slot bound to a dead request
+        first = int(tok)
+        assert (
+            self.slots.admit(request, first, next_pos=plen, generated=gen0 + 1)
+            == slot
+        )
+        self.prefix_index.insert(slot, prompt)
+        self.tokens_out += 1
+        self._record_compile_gauges()
+        return slot, first
+
+    def _admit_dense(self, prompt, p, slot, gen0, reuse, key_pair):
+        """Dense-mode admission: full-row copy into the batch cache."""
+        plen = len(prompt)
         if reuse is not None:
             src, shared = reuse
             # the suffix bucket must still fit above the shared rows — cap it
             # so the per-row cache write can never be position-clamped
             bucket = min(self._bucket(plen - shared), self.max_seq_len - shared)
             padded = np.zeros((1, bucket), np.int32)
-            padded[0, : plen - shared] = request.prompt[shared:]
+            padded[0, : plen - shared] = prompt[shared:]
             with self.telemetry.span(
                 "serve.prefix_admit", bucket=bucket, shared=shared
             ), self._ctx():
@@ -419,18 +650,16 @@ class Engine:
                     jnp.asarray(padded),
                     jnp.int32(shared),
                     jnp.int32(plen),
+                    jnp.int32(gen0),
                     jnp.float32(p.temperature),
                     jnp.int32(p.top_k),
                     key_pair,
                 )
-            self.prefix_hits += 1
-            self.prefix_tokens_saved += shared
-            self.telemetry.count("serve.prefix_hits")
-            self.telemetry.count("serve.prefix_tokens_saved", shared)
+            self._note_prefix_hit(shared, 0)
         else:
             bucket = self._bucket(plen)
             padded = np.zeros((1, bucket), np.int32)
-            padded[0, :plen] = request.prompt
+            padded[0, :plen] = prompt
             with self.telemetry.span("serve.prefill", bucket=bucket), self._ctx():
                 row_cache, tok = self._prefill_jit(
                     self.params,
@@ -439,6 +668,7 @@ class Engine:
                     jnp.float32(p.temperature),
                     jnp.int32(p.top_k),
                     key_pair,
+                    jnp.int32(gen0),
                 )
                 self.cache, self.key_data = self._admit_jit(
                     self.cache,
@@ -449,14 +679,104 @@ class Engine:
                     key_pair,
                 )
             self.prefill_calls += 1
-        # claim the slot only after every device op succeeded — a throwing
-        # prefill/admit must not leak an occupied slot bound to a dead request
-        first = int(tok)
-        assert self.slots.admit(request, first) == slot
-        self.prefix_index.insert(slot, request.prompt)
-        self.tokens_out += 1
-        self._record_compile_gauges()
-        return slot, first
+        return tok
+
+    def _admit_paged(self, prompt, p, slot, gen0, reuse, key_pair):
+        """Paged admission: allocate the prompt's pages (aliasing the shared
+        full pages on a prefix hit), prefill (suffix-only on a hit), and
+        re-page the resulting dense row through ``write_ids``. Allocation is
+        rolled back if any device op throws, so a poison request leaks
+        nothing."""
+        plen = len(prompt)
+        P = self.page_size
+        n_prompt_pages = -(-plen // P)
+        write_ids = np.zeros((self.pages_per_row,), np.int32)
+        if reuse is not None:
+            src, shared = reuse
+            src_pages = self.page_table.pages(src)
+            # full pages covered by the shared prefix are aliased; the
+            # partial boundary page (if any) is copy-on-write — a fresh
+            # page written from the workspace row
+            shared_full = min(shared // P, len(src_pages), n_prompt_pages)
+            fresh = self.allocator.alloc(n_prompt_pages - shared_full)
+            aliased = src_pages[:shared_full]
+            try:
+                self.allocator.share(aliased)
+            except Exception:
+                self.allocator.release(fresh)
+                raise
+            page_list = aliased + fresh
+            write_ids[shared_full:n_prompt_pages] = fresh
+            bucket = min(self._bucket(plen - shared), self.max_seq_len - shared)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : plen - shared] = prompt[shared:]
+            try:
+                with self.telemetry.span(
+                    "serve.prefix_admit", bucket=bucket, shared=shared
+                ), self._ctx():
+                    self.cache, self.key_data, tok = self._paged_prefix_admit_jit(
+                        self.params,
+                        self.cache,
+                        self.key_data,
+                        jnp.asarray(self.page_table.row(src)),
+                        jnp.asarray(write_ids),
+                        jnp.int32(slot),
+                        jnp.asarray(padded),
+                        jnp.int32(shared),
+                        jnp.int32(plen),
+                        jnp.int32(gen0),
+                        jnp.float32(p.temperature),
+                        jnp.int32(p.top_k),
+                        key_pair,
+                    )
+            except Exception:
+                self.allocator.release(page_list)
+                raise
+            self._note_prefix_hit(shared, shared_full)
+        else:
+            fresh = self.allocator.alloc(n_prompt_pages)
+            page_list = fresh
+            write_ids[:n_prompt_pages] = fresh
+            bucket = self._bucket(plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = prompt
+            try:
+                with self.telemetry.span(
+                    "serve.prefill", bucket=bucket
+                ), self._ctx():
+                    row_cache, tok = self._prefill_jit(
+                        self.params,
+                        jnp.asarray(padded),
+                        jnp.int32(plen),
+                        jnp.float32(p.temperature),
+                        jnp.int32(p.top_k),
+                        key_pair,
+                        jnp.int32(gen0),
+                    )
+                    self.cache, self.key_data = self._paged_admit_jit(
+                        self.cache,
+                        row_cache,
+                        self.key_data,
+                        jnp.asarray(write_ids),
+                        jnp.int32(slot),
+                        jnp.int32(plen),
+                        key_pair,
+                    )
+            except Exception:
+                self.allocator.release(fresh)
+                raise
+            self.prefill_calls += 1
+        self.page_table.assign(slot, page_list)
+        self._push_page_table()
+        self._pages_gauges()
+        return tok
+
+    def _note_prefix_hit(self, shared: int, shared_full_pages: int) -> None:
+        self.prefix_hits += 1
+        self.prefix_tokens_saved += shared
+        self.pages_aliased += shared_full_pages
+        self.telemetry.count("serve.prefix_hits")
+        self.telemetry.count("serve.prefix_tokens_saved", shared)
 
     def _match_prefix(self, prompt) -> Optional[Tuple[int, int]]:
         """``(src_slot, shared_len)`` when a resident slot shares a usable
@@ -499,9 +819,31 @@ class Engine:
         B = num_slots
         self.slots = SlotManager(B)
         self.prefix_index = PrefixIndex(min_len=self.prefix_min)
+        if self.paged:
+            from maggy_tpu.models import Decoder
+
+            # pool scales with the slot count unless the operator pinned an
+            # explicit page budget (then more slots share the same HBM —
+            # the paged trade the autopilot's num_slots moves exploit)
+            if not self._num_pages_explicit:
+                self.num_pages = B * self.pages_per_row + 1
+            self.paged_model = Decoder(
+                dataclasses.replace(
+                    self.cfg,
+                    decode=True,
+                    paged=True,
+                    page_size=self.page_size,
+                    num_pages=self.num_pages,
+                )
+            )
+            self._batch_model = self.paged_model
+            self.allocator = BlockAllocator(self.num_pages, self.page_size)
+            self.page_table = PageTable(B, self.pages_per_row)
+            self._last_page_gauges = None
         self.cache = init_cache(
-            self.decode_model, jnp.zeros((B, 1), jnp.int32), mesh=self.mesh
+            self._batch_model, jnp.zeros((B, 1), jnp.int32), mesh=self.mesh
         )
+        self._push_page_table()
         self.key_data = jnp.zeros((B, 2), jnp.uint32)
         self._zero_tokens = jnp.zeros((B,), jnp.int32)
         self._pending = None
@@ -519,11 +861,86 @@ class Engine:
         self._record_compile_gauges()
 
     def release(self, slot: int) -> Request:
-        """Free a slot (EOS / max_new / cancel / deadline). Pure host-side:
-        the decode step already zeroes inactive rows' cache index, and
-        admission overwrites the full row."""
+        """Free a slot (EOS / max_new / cancel / deadline / preempt). THE
+        one cache-resource release seam: every path that vacates a slot
+        funnels through here, so pages and the prefix anchor can never leak
+        on one exit path but not another. Pure host-side: the decode step
+        zeroes inactive rows' cache index, paged writes of a cleared row
+        are routed to the scratch page, and admission overwrites whole
+        pages/rows."""
+        if self.paged:
+            pages = self.page_table.clear(slot)
+            if pages:
+                self.allocator.release(pages)
+            self._pages_gauges()
         self.prefix_index.remove(slot)
         return self.slots.evict(slot)
+
+    # ------------------------------------------------------------ page growth
+
+    def prepare_step(self) -> List[int]:  # hot-loop (paged decode growth)
+        """Paged only: make sure every active row owns the page its next
+        write lands in (a row crosses a page boundary every ``page_size``
+        tokens). Returns the slots whose growth the dry allocator refused —
+        the scheduler preempts the youngest request and retries; an empty
+        list means :meth:`step` is safe to dispatch. Dense mode returns
+        ``[]`` unconditionally."""
+        if not self.paged:
+            return []
+        needy: List[int] = []
+        prev = self._pending
+        P = self.page_size
+        grew = False
+        for s in self.slots.active_slots():
+            st = self.slots.get(s)
+            lag = (
+                1
+                if (
+                    self.async_decode
+                    and prev is not None
+                    and prev["slots"].get(s) == st.request.id
+                )
+                else 0
+            )
+            need = (st.next_pos + lag) // P + 1
+            while self.page_table.count(s) < need:
+                try:
+                    page = self.allocator.alloc(1)[0]
+                except OutOfPagesError:
+                    needy.append(s)
+                    break
+                self.page_table.grow(s, page)
+                grew = True
+        if grew:
+            self._pages_gauges()
+        return needy
+
+    def _push_page_table(self) -> None:
+        """Sync the host page-table mirror into the cache variable the
+        compiled decode step gathers through. Cheap no-op unless admission,
+        release, or growth dirtied the mirror — the steady-state decode
+        fast path transfers nothing."""
+        if not self.paged or not self.page_table.dirty:
+            return
+        tbl = jnp.asarray(self.page_table.table)
+
+        def repl(path, leaf):
+            if "pages" in jax.tree_util.keystr(path):
+                return jnp.broadcast_to(tbl, leaf.shape)
+            return leaf
+
+        self.cache = jax.tree_util.tree_map_with_path(repl, self.cache)
+        self.page_table.dirty = False
+
+    def _pages_gauges(self) -> None:
+        # journaled only on change, like the compile gauges: page counts
+        # move at admission/release/boundary granularity, not per token
+        a = self.allocator
+        vals = (a.pages_free, a.pages_shared)
+        if vals != self._last_page_gauges:
+            self._last_page_gauges = vals
+            self.telemetry.gauge("serve.pages_free", a.pages_free)
+            self.telemetry.gauge("serve.pages_shared", a.pages_shared)
 
     # ----------------------------------------------------------------- decode
 
@@ -539,6 +956,16 @@ class Engine:
         active_ids = self.slots.active_slots()
         if not active_ids:
             return self.flush()
+        if self.paged:
+            # page growth for this dispatch (no-op when the scheduler's
+            # prepare_step/preempt pass already ran) + table sync if dirty
+            needy = self.prepare_step()
+            if needy:
+                raise OutOfPagesError(
+                    f"slots {needy} need pages and the pool is dry; "
+                    "release or preempt before stepping"
+                )
+            self._push_page_table()
         prev = self._pending
         entries = {s: self.slots.get(s).request.id for s in active_ids}
         if (
@@ -647,6 +1074,117 @@ class Engine:
         self.tokens_out += len(out)
         return StepOutput(tokens=out)
 
+    # ------------------------------------------------- disaggregated prefill
+
+    def prefill_only(self, prompt: List[int], params, gen0: int = 0) -> Dict[str, Any]:
+        """The prefill half of disaggregated serving (docs/fleet.md
+        "Disaggregated prefill/decode"): run one prompt through the
+        single-row prefill program — slots, batch cache, and the page pool
+        are untouched — and return a host-resident KV pack. The pack's
+        leaves are numpy (``jax.device_get``), which IS the serialization
+        boundary: a decode replica re-materializes them with a device put
+        in :meth:`admit_from_kv`, exactly the checkpoint/device-put path.
+
+        Byte-identity holds end to end because prefill output is a pure
+        function of (params, prompt, seed) and the host round-trip
+        preserves bits."""
+        prompt = [int(t) for t in prompt]
+        plen = len(prompt)
+        if plen < 1:
+            raise BadArgumentsError("empty prompt")
+        if plen >= self.max_seq_len:
+            raise BadArgumentsError(
+                f"prompt ({plen}) exceeds max_seq_len ({self.max_seq_len})"
+            )
+        key_pair = jnp.asarray(_base_key_data(params.seed))
+        bucket = self._bucket(plen)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = prompt
+        with self.telemetry.span("serve.prefill", bucket=bucket), self._ctx():
+            row_cache, tok = self._prefill_jit(
+                self.params,
+                jnp.asarray(padded),
+                jnp.int32(plen),
+                jnp.float32(params.temperature),
+                jnp.int32(params.top_k),
+                key_pair,
+                jnp.int32(gen0),
+            )
+        self.prefill_calls += 1
+        self._record_compile_gauges()
+        return {
+            "row": jax.device_get(row_cache),
+            "plen": plen,
+            "first": int(tok),
+        }
+
+    def admit_from_kv(self, request: Request, pack: Dict[str, Any]) -> Tuple[int, int]:
+        """Admit a request whose prompt a PREFILL replica already ran: the
+        pack's dense row is device-put here and written into the batch
+        cache (re-paged through fresh pages in paged mode) — no model
+        forward runs on this engine for the prompt. Returns
+        ``(slot, first_token)``; the first token was sampled at prefill
+        time and rides in the pack."""
+        p = request.params
+        plen = int(pack["plen"])
+        if request.tokens or plen != len(request.prompt):
+            raise BadArgumentsError(
+                "KV pack does not match the request state (stale handoff)"
+            )
+        if plen + p.max_new > self.max_seq_len:
+            raise BadArgumentsError(
+                f"prompt ({plen}) + max_new ({p.max_new}) exceeds "
+                f"max_seq_len ({self.max_seq_len})"
+            )
+        if not self.slots.free_slots():
+            raise SlotOccupiedError("no free slot")
+        key_pair = jnp.asarray(_base_key_data(p.seed))
+        slot = self.slots.free_slots()[0]
+        with self.telemetry.span("serve.kv_admit", plen=plen), self._ctx():
+            row_cache = jax.tree.map(jnp.asarray, pack["row"])  # device put
+            if self.paged:
+                worst = -(-(plen + p.max_new) // self.page_size)
+                cap = min(self.max_pages_per_req, self.allocator.pages_total)
+                if worst > cap:
+                    raise BadArgumentsError(
+                        f"request needs up to {worst} pages > cap {cap}"
+                    )
+                n_prompt_pages = -(-plen // self.page_size)
+                fresh = self.allocator.alloc(n_prompt_pages)
+                write_ids = np.zeros((self.pages_per_row,), np.int32)
+                write_ids[:n_prompt_pages] = fresh
+                try:
+                    self.cache, self.key_data = self._paged_admit_jit(
+                        self.cache,
+                        row_cache,
+                        self.key_data,
+                        jnp.asarray(write_ids),
+                        jnp.int32(slot),
+                        jnp.int32(plen),
+                        key_pair,
+                    )
+                except Exception:
+                    self.allocator.release(fresh)
+                    raise
+                self.page_table.assign(slot, fresh)
+                self._push_page_table()
+                self._pages_gauges()
+            else:
+                self.cache, self.key_data = self._admit_jit(
+                    self.cache,
+                    row_cache,
+                    self.key_data,
+                    jnp.int32(slot),
+                    jnp.int32(plen),
+                    key_pair,
+                )
+        first = int(pack["first"])
+        assert self.slots.admit(request, first) == slot
+        self.prefix_index.insert(slot, [int(t) for t in request.prompt])
+        self.tokens_out += 1
+        self._record_compile_gauges()
+        return slot, first
+
     # -------------------------------------------------------------- telemetry
 
     def _record_compile_gauges(self) -> None:
@@ -670,10 +1208,30 @@ class Engine:
 
     @property
     def prefix_stats(self) -> Dict[str, int]:
-        """Reuse accounting for SSTATS/telemetry: hits, tokens the copy
+        """Reuse accounting for SSTATS/telemetry: hits, tokens the reuse
         saved from prefill, and full prefills actually run."""
         return {
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_saved": self.prefix_tokens_saved,
             "prefill_calls": self.prefill_calls,
         }
+
+    @property
+    def paging_stats(self) -> Dict[str, Any]:
+        """Paged-cache accounting for SSTATS/monitor/bench: pool occupancy,
+        sharing, and the per-request page cap. ``{"paged": False}`` on the
+        dense fallback so panels can branch without key errors."""
+        if not self.paged:
+            return {"paged": False}
+        return {
+            "paged": True,
+            "max_pages_per_req": self.max_pages_per_req,
+            "pages_aliased_total": self.pages_aliased,
+            **self.allocator.stats(),
+        }
+
+    def set_max_pages_per_req(self, value: int) -> None:
+        """Autopilot seam (``serve.max_pages_per_req``, safe-live): caps how
+        many pages ONE request may hold. Applies to future admissions and
+        growth denials only — resident requests keep what they own."""
+        self.max_pages_per_req = max(1, min(self.pages_per_row, int(value)))

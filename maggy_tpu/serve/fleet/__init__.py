@@ -22,6 +22,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from maggy_tpu.serve.fleet.prefill import (  # noqa: F401
+    PrefillWorker,
+    PrefillWorkerError,
+)
 from maggy_tpu.serve.fleet.replica import (  # noqa: F401
     Replica,
     ReplicaSpec,
@@ -34,6 +38,8 @@ from maggy_tpu.serve.fleet.router import (  # noqa: F401
 )
 
 __all__ = [
+    "PrefillWorker",
+    "PrefillWorkerError",
     "Replica",
     "ReplicaSpec",
     "Router",
@@ -53,13 +59,19 @@ def launch_fleet(
     host: str = "127.0.0.1",
     telemetry_recorder=None,
     autopilot=None,
+    prefill_replicas: int = 0,
     **config_kwargs,
 ) -> Router:
     """Build a router over ``replicas`` fresh in-process replicas (device
     leases carved like trial sub-slices). Call ``router.start()`` to serve;
     extra kwargs go to :class:`RouterConfig` (``slo_ttft_ms=...`` etc.);
     ``autopilot`` attaches an online controller to the router
-    (docs/autotune.md "Continuous tuning")."""
+    (docs/autotune.md "Continuous tuning").
+
+    ``prefill_replicas > 0`` builds a DISAGGREGATED fleet (docs/fleet.md):
+    ``replicas`` decode-role replicas plus that many prefill-role replicas —
+    the router prefills each prompt on a prefill replica and hands the KV
+    pack to a decode replica."""
     if config is None:
         config = RouterConfig(**config_kwargs)
     elif config_kwargs:
@@ -68,8 +80,20 @@ def launch_fleet(
         # thread the fleet SLO down so each replica's scheduler counts
         # exact per-request attainment in its own SSTATS
         spec = dataclasses.replace(spec, slo_ttft_ms=config.slo_ttft_ms)
+    fleet = build_replicas(
+        dataclasses.replace(spec, role="decode") if prefill_replicas else spec,
+        replicas,
+        secret or "",
+        host=host,
+    )
+    if prefill_replicas:
+        prefill_spec = dataclasses.replace(spec, role="prefill")
+        for i in range(prefill_replicas):
+            fleet.append(
+                Replica(replicas + i, prefill_spec, secret or "", host=host)
+            )
     router = Router(
-        build_replicas(spec, replicas, secret or "", host=host),
+        fleet,
         config=config,
         secret=secret,
         name=name,

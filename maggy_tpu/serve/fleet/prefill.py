@@ -1,0 +1,88 @@
+"""Prefill worker: the compute-bound half of disaggregated serving.
+
+The Gemma-on-TPU serving comparison (PAPERS.md) quantifies the asymmetry
+this role exploits: prefill is compute-bound (one big batched forward over
+the prompt), decode is memory-bound (one token per step, HBM-limited).
+Mixing them on one replica makes every decode wave stall behind whichever
+prompt is currently prefilling. A fleet can instead tag replicas
+``role="prefill"`` (:class:`~maggy_tpu.serve.fleet.replica.ReplicaSpec`):
+the router sends each SUBMIT's prompt to a prefill replica first, then
+hands the resulting KV pack to a decode replica, which admits it without
+running the prompt (``Engine.admit_from_kv``).
+
+The handoff payload is :meth:`Engine.prefill_only`'s host-resident pack
+(numpy leaves via ``jax.device_get`` — the same serialization surface the
+checkpoint path uses); the decode replica re-materializes it with a device
+put. For in-process replicas the pack moves by reference; a cross-host
+fleet would ship the same bytes over the wire. ``req.prefilled`` and
+``req.handoff`` trace events plus the ``serve.handoff_ms`` histogram make
+the hop visible on each request's PR 7 trace lane.
+
+A :class:`PrefillWorker` wraps a prefill-role replica's engine behind a
+lock (prefill programs are single-threaded by engine contract). If every
+prefill replica is down, the router falls back to plain dispatch — decode
+replicas still own a full engine, so disaggregation degrades to the
+classic path instead of an outage.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from maggy_tpu.serve.request import SamplingParams
+
+
+class PrefillWorkerError(RuntimeError):
+    """Prefill-side failure; the router falls back to plain dispatch."""
+
+
+class PrefillWorker:
+    """Router-owned prefill front over a ``role="prefill"`` replica."""
+
+    def __init__(self, replica):
+        self.replica = replica
+        self._lock = threading.Lock()
+        self.prefills = 0
+
+    @property
+    def index(self) -> int:
+        return self.replica.index
+
+    def alive(self) -> bool:
+        return self.replica.alive() and self.replica.server is not None
+
+    def prefill(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Run one SUBMIT payload's prompt on the prefill replica's engine
+        and return the host-resident KV pack (``Engine.prefill_only``)."""
+        if not self.alive():
+            raise PrefillWorkerError(f"prefill replica {self.index} is down")
+        params = SamplingParams(
+            temperature=float(payload.get("temperature", 0.0)),
+            top_k=int(payload.get("top_k", 0)),
+            max_new=int(payload.get("max_new", 16)),
+            eos_id=int(payload.get("eos_id", -1)),
+            seed=int(payload.get("seed", 0)),
+        )
+        engine = self.replica.server.scheduler.engine
+        try:
+            with self._lock:
+                pack = engine.prefill_only(payload["prompt"], params)
+        except Exception as e:  # noqa: BLE001 - surface as a worker failure, router falls back
+            raise PrefillWorkerError(
+                f"prefill on replica {self.index} failed: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+        self.prefills += 1
+        return pack
+
+
+def pick_worker(workers, cursor: int) -> Optional[PrefillWorker]:
+    """Round-robin over live prefill workers (None when all are down)."""
+    if not workers:
+        return None
+    for offset in range(len(workers)):
+        w = workers[(cursor + offset) % len(workers)]
+        if w.alive():
+            return w
+    return None

@@ -42,6 +42,17 @@ class ReplicaSpec:
     mesh: Any = None
     async_decode: Optional[bool] = None
     prefix_reuse: Optional[bool] = None
+    # disaggregated prefill/decode (docs/fleet.md): "any" replicas serve the
+    # classic full stack; "prefill" replicas only run prompt prefills (the
+    # router wraps them in PrefillWorker and never dispatches SUBMIT to
+    # them); "decode" replicas admit handed-off KV packs (and still CAN
+    # prefill — the fallback when every prefill replica is down)
+    role: str = "any"
+    # paged KV cache knobs threaded to each replica's engine (None: engine
+    # defaults — paged on, DEFAULT_PAGE_SIZE)
+    paged: Optional[bool] = None
+    page_size: Optional[int] = None
+    num_pages: Optional[int] = None
     # TTFT budget handed to each replica's scheduler so per-replica SSTATS
     # carry exact slo_ok/slo_miss counters (launch_fleet seeds it from
     # RouterConfig.slo_ttft_ms)
@@ -96,6 +107,9 @@ class Replica:
             telemetry_recorder=tel,
             async_decode=spec.async_decode,
             prefix_reuse=spec.prefix_reuse,
+            paged=spec.paged,
+            page_size=spec.page_size,
+            num_pages=spec.num_pages,
         )
         self.server = ServeServer(
             Scheduler(engine, slo_ttft_ms=spec.slo_ttft_ms),
@@ -169,9 +183,37 @@ class Replica:
         except Exception:  # noqa: BLE001 - racing a concurrent kill()
             return None
 
+    def submit_prefilled(self, payload: Dict[str, Any], pack: Dict[str, Any]) -> str:
+        """Disaggregated handoff (in-process seam): enqueue a request whose
+        KV pack a prefill replica produced. Returns the downstream request
+        id, exactly like ``client.submit`` — POLL/CANCEL work unchanged.
+        Raises for a remote/dead replica; the router falls back to a plain
+        submit (the decode engine prefills for itself)."""
+        if self.state != UP or self.server is None:
+            raise RuntimeError(f"replica {self.index} cannot accept a handoff")
+        from maggy_tpu.serve.request import SamplingParams
+
+        params = SamplingParams(
+            temperature=float(payload.get("temperature", 0.0)),
+            top_k=int(payload.get("top_k", 0)),
+            max_new=int(payload.get("max_new", 16)),
+            eos_id=int(payload.get("eos_id", -1)),
+            seed=int(payload.get("seed", 0)),
+        )
+        deadline_s = payload.get("deadline_s")
+        req = self.server.scheduler.submit_prefilled(
+            payload["prompt"],
+            params,
+            pack,
+            deadline_s=float(deadline_s) if deadline_s else None,
+            trace=payload.get("trace"),
+        )
+        return req.id
+
     def describe(self) -> Dict[str, Any]:
         return {
             "replica": self.index,
+            "role": self.spec.role,
             "state": self.state,
             "addr": f"{self.addr[0]}:{self.addr[1]}" if self.addr else None,
             "restarts": self.restarts,

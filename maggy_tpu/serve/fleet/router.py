@@ -29,6 +29,17 @@ dashboard) points at a fleet unchanged. Behind the verbs:
   (``MAGGY_TPU_CHAOS="replica_kill:replica=N"``) kills a busy replica
   deterministically so all of this is testable on one CPU.
 
+* **Disaggregated prefill/decode.** Replicas tagged ``role="prefill"``
+  (:class:`~maggy_tpu.serve.fleet.replica.ReplicaSpec`) never receive
+  SUBMIT dispatches; instead the pump runs each accepted prompt through a
+  :class:`~maggy_tpu.serve.fleet.prefill.PrefillWorker` first and hands
+  the resulting KV pack to the chosen decode replica
+  (``Engine.admit_from_kv`` — the device-put/serialization path).
+  ``req.prefilled``/``req.handoff`` events mark the hop on the request's
+  trace lane and ``serve.handoff_ms`` measures it; when every prefill
+  replica is down the router falls back to plain dispatch (decode replicas
+  keep a full engine). See docs/fleet.md "Disaggregated prefill/decode".
+
 Handlers run on the RPC event loop and only touch lock-guarded host state;
 every downstream socket round-trip (dispatch, poll fan-out, probes) belongs
 to the pump thread.
@@ -48,6 +59,11 @@ from maggy_tpu.core import rpc
 from maggy_tpu.exceptions import RpcError, RpcRejectedError
 from maggy_tpu.resilience import chaos as chaos_mod
 from maggy_tpu.resilience.policy import QuarantineTracker
+from maggy_tpu.serve.fleet.prefill import (
+    PrefillWorker,
+    PrefillWorkerError,
+    pick_worker,
+)
 from maggy_tpu.serve.fleet.replica import DEAD, UP, Replica
 from maggy_tpu.serve.scheduler import LATENCY_SIGNALS
 from maggy_tpu.telemetry import tracing
@@ -184,6 +200,21 @@ class Router:
                     telemetry_recorder=self.telemetry,
                 )
             )
+        # disaggregation: prefill-role replicas become pump-owned prefill
+        # workers and are excluded from SUBMIT dispatch
+        self.prefill_workers = [
+            PrefillWorker(r)
+            for r in self.replicas
+            if getattr(r.spec, "role", "any") == "prefill"
+        ]
+        if self.prefill_workers and not any(
+            getattr(r.spec, "role", "any") != "prefill" for r in self.replicas
+        ):
+            raise ValueError(
+                "a disaggregated fleet needs at least one decode-capable "
+                "replica (role 'decode' or 'any')"
+            )
+        self._pw_rr = 0  # prefill-worker round-robin cursor
         self._rpc = rpc.Server(num_executors=0, secret=secret)
         self._rpc.telemetry = self.telemetry
         self.quarantine = QuarantineTracker(
@@ -206,6 +237,10 @@ class Router:
             "expired": 0,
             "cancelled": 0,
             "respawned": 0,
+            # disaggregation: prompts run on a prefill replica, and KV
+            # packs handed to a decode replica (docs/fleet.md)
+            "prefilled": 0,
+            "handoffs": 0,
         }
         # exact SLO attainment at the fleet edge: counted per completed
         # request against the configured TTFT budget (histogram-derived
@@ -286,11 +321,15 @@ class Router:
     # ------------------------------------------------------------ projections
 
     def _healthy(self) -> List[Replica]:
+        """Dispatch targets: healthy decode-capable replicas (prefill-only
+        replicas are PrefillWorkers, never SUBMIT targets)."""
         now = time.time()
         return [
             r
             for r in self.replicas
-            if r.state == UP and not self.quarantine.is_quarantined(r.index, now)
+            if r.state == UP
+            and getattr(r.spec, "role", "any") != "prefill"
+            and not self.quarantine.is_quarantined(r.index, now)
         ]
 
     def _pick_replica(self, healthy: List[Replica]) -> Tuple[Replica, float]:
@@ -454,6 +493,11 @@ class Router:
             "prefix_hits": 0,
             "prefix_tokens_saved": 0,
             "prefill_calls": 0,
+            # paged KV cache, summed over paged replicas (docs/serving.md)
+            "pages_total": 0,
+            "pages_free": 0,
+            "pages_shared": 0,
+            "preemptions": 0,
         }
         latency_dicts: Dict[str, List[Dict[str, Any]]] = {
             name: [] for name in LATENCY_SIGNALS
@@ -491,8 +535,14 @@ class Router:
                 "prefix_hits",
                 "prefix_tokens_saved",
                 "prefill_calls",
+                "preemptions",
             ):
                 agg[k] += stats.get(k, 0)
+            paging = stats.get("paging") or {}
+            if paging.get("paged"):
+                for k in ("pages_total", "pages_free", "pages_shared"):
+                    agg[k] += paging.get(k, 0)
+                row["pages_free"] = paging.get("pages_free")
             for name, d in (stats.get("latency") or {}).items():
                 latency_dicts.setdefault(name, []).append(d)
         merged = {
@@ -764,18 +814,22 @@ class Router:
                 "req.dispatched", trace=entry.trace, rid=entry.rid,
                 replica=best.index, resubmits=entry.resubmits,
             )
-            try:
-                remote_id = best.client.submit(**entry.payload)
-            except RpcRejectedError as e:
-                with self._lock:
-                    self._finish_local(entry, "failed", str(e))
-                continue
-            except (RpcError, OSError) as e:
-                with self._lock:
-                    entry.state = REQUEUED
-                    self._pending.appendleft(rid)
-                self._note_failure(best, f"submit: {type(e).__name__}")
-                return
+            remote_id = None
+            if self.prefill_workers:
+                remote_id = self._dispatch_disaggregated(entry, best)
+            if remote_id is None:
+                try:
+                    remote_id = best.client.submit(**entry.payload)
+                except RpcRejectedError as e:
+                    with self._lock:
+                        self._finish_local(entry, "failed", str(e))
+                    continue
+                except (RpcError, OSError) as e:
+                    with self._lock:
+                        entry.state = REQUEUED
+                        self._pending.appendleft(rid)
+                    self._note_failure(best, f"submit: {type(e).__name__}")
+                    return
             with self._lock:
                 entry.state = ROUTED
                 entry.replica = best.index
@@ -785,6 +839,50 @@ class Router:
                 cached = self._stats_cache.setdefault(best.index, {})
                 cached["queue_depth"] = cached.get("queue_depth", 0) + 1
             self.telemetry.count("fleet.routed")
+
+    def _dispatch_disaggregated(self, entry: RouteEntry, best: Replica):
+        """Disaggregated dispatch (pump thread): run the prompt on a
+        prefill replica, hand the KV pack to the chosen decode replica.
+        Returns the downstream request id, or None to fall back to plain
+        dispatch (prefill fleet down / handoff unsupported) — the decode
+        replica's full engine then prefills for itself, so disaggregation
+        degrades, never outages."""
+        worker = pick_worker(self.prefill_workers, self._pw_rr)
+        self._pw_rr += 1
+        if worker is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            pack = worker.prefill(entry.payload)
+        except PrefillWorkerError as e:
+            self.log(f"prefill fallback: {e}")
+            return None
+        with self._lock:
+            self.counters["prefilled"] += 1
+        self.telemetry.event(
+            "req.prefilled", trace=entry.trace, rid=entry.rid,
+            replica=worker.index,
+            plen=len(entry.payload.get("prompt", [])),
+        )
+        try:
+            remote_id = best.submit_prefilled(entry.payload, pack)
+        except Exception as e:  # noqa: BLE001 - dead/remote decode replica: plain dispatch retries
+            self.log(f"handoff fallback: {type(e).__name__}: {e}")
+            return None
+        # handoff latency: prefill dispatch -> KV pack accepted by the
+        # decode replica (covers the device_get serialization; the decode
+        # side's device put shows up in its serve.kv_admit span)
+        handoff_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self.counters["handoffs"] += 1
+        self.telemetry.gauge("serve.handoff_ms", handoff_ms)
+        self.telemetry.histogram("serve.handoff_ms", handoff_ms)
+        self.telemetry.event(
+            "req.handoff", trace=entry.trace, rid=entry.rid,
+            prefill_replica=worker.index, decode_replica=best.index,
+            handoff_ms=round(handoff_ms, 3),
+        )
+        return remote_id
 
     def _poll_routed(self) -> None:
         with self._lock:

@@ -1,0 +1,218 @@
+"""Host-side block allocator and per-slot page table for the paged KV cache.
+
+The allocator owns the physical page id space ``[0, num_pages)``. Page
+``SCRATCH_PAGE`` (0) is reserved: unallocated page-table entries point at it
+and masked/inactive device writes are routed there, so its content is
+garbage by design and nothing ever reads it as valid. All other pages move
+between exactly three states:
+
+* **free** — on the free list, refcount 0;
+* **owned** — refcount 1, exactly one request's page list holds it;
+* **shared** — refcount >= 2, a prefix-aliased page held by several page
+  lists. Shared pages are read-only by contract: the engine only writes a
+  page while it is owned (admission writes fresh pages; decode writes the
+  tail page past ``plen``, which aliasing can never cover — see
+  ``docs/serving.md`` "Paged KV cache"). ``release`` decrements and frees
+  at zero, so the last sharer's eviction reclaims the page.
+
+Invariants (the property tests in ``tests/test_paged_kv.py`` hammer these):
+``alloc`` is atomic (all-or-nothing under :class:`OutOfPagesError`), a page
+is never double-freed, never on the free list while referenced, and
+``pages_free + pages_referenced == num_pages - 1`` always.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+# physical page 0: garbage sink for unallocated table entries and masked
+# writes; never allocated, never read as valid
+SCRATCH_PAGE = 0
+
+
+class OutOfPagesError(RuntimeError):
+    """The pool cannot satisfy an allocation; the scheduler's response is
+    backpressure (queued admissions wait) or preemption (decode growth
+    evicts the youngest request) — never a failed request."""
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_pages`` fixed-size pages with
+    per-page reference counts (prefix aliasing shares pages)."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page {SCRATCH_PAGE} is reserved), "
+                f"got {num_pages}"
+            )
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list: recently-freed pages are re-used first (their
+        # content is about to be fully overwritten anyway, and temporal
+        # locality keeps the hot working set small)
+        self._free: List[int] = list(range(self.num_pages - 1, SCRATCH_PAGE, -1))
+        self._refs: Dict[int, int] = {}
+        # cumulative counters (monotonic; bench/stats)
+        self.allocs = 0
+        self.shares = 0
+
+    # ------------------------------------------------------------------ state
+
+    @property
+    def pages_total(self) -> int:
+        """Allocatable pages (the scratch page is not part of the budget)."""
+        return self.num_pages - 1
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_shared(self) -> int:
+        """Pages currently referenced by more than one page list."""
+        return sum(1 for n in self._refs.values() if n >= 2)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
+    # ------------------------------------------------------------------ moves
+
+    def alloc(self, n: int) -> List[int]:
+        """``n`` fresh pages (refcount 1 each), atomically — on
+        :class:`OutOfPagesError` nothing was taken."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise OutOfPagesError(
+                f"need {n} page(s), {len(self._free)} free "
+                f"of {self.pages_total}"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        self.allocs += n
+        return out
+
+    def share(self, pages: Sequence[int]) -> None:
+        """Alias already-allocated pages into another page list
+        (refcount += 1). Sharing a free or scratch page is a bug."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if p == SCRATCH_PAGE or p not in self._refs:
+                raise ValueError(f"share of unallocated page {p}")
+        for p in pages:
+            self._refs[p] += 1
+        self.shares += len(pages)
+
+    def release(self, pages: Sequence[int]) -> int:
+        """Drop one reference per page; pages reaching refcount 0 return to
+        the free list. Returns how many were actually freed. Double-free
+        (releasing a page no list holds) raises."""
+        freed = 0
+        for p in (int(p) for p in pages):
+            refs = self._refs.get(p)
+            if refs is None:
+                raise ValueError(f"double free of page {p}")
+            if refs == 1:
+                del self._refs[p]
+                self._free.append(p)
+                freed += 1
+            else:
+                self._refs[p] = refs - 1
+        return freed
+
+    def check_invariants(self) -> None:
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate pages on the free list"
+        assert SCRATCH_PAGE not in free and SCRATCH_PAGE not in self._refs
+        assert not (free & set(self._refs)), "page both free and referenced"
+        assert len(free) + len(self._refs) == self.pages_total
+        assert all(n >= 1 for n in self._refs.values())
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "pages_total": self.pages_total,
+            "pages_free": self.pages_free,
+            "pages_shared": self.pages_shared,
+            "page_size": self.page_size,
+            "page_allocs": self.allocs,
+            "page_shares": self.shares,
+        }
+
+
+class PageTable:
+    """Host mirror of the device page-table cache variable: one ordered
+    page list per slot, flattened into the ``[num_slots, max_pages]`` int32
+    array the compiled decode step gathers through. Unused entries hold
+    ``SCRATCH_PAGE``. The engine pushes ``table`` to the device whenever
+    ``dirty`` (admission, release, growth) — the mirror is the single
+    source of truth."""
+
+    def __init__(self, num_slots: int, max_pages: int):
+        self.num_slots = int(num_slots)
+        self.max_pages = int(max_pages)
+        self.table = np.full(
+            (self.num_slots, self.max_pages), SCRATCH_PAGE, np.int32
+        )
+        self._lists: Dict[int, List[int]] = {}
+        self.dirty = True  # first push seeds the device copy
+
+    def pages(self, slot: int) -> List[int]:
+        return list(self._lists.get(slot, ()))
+
+    def count(self, slot: int) -> int:
+        return len(self._lists.get(slot, ()))
+
+    def assign(self, slot: int, pages: Sequence[int]) -> None:
+        pages = [int(p) for p in pages]
+        if len(pages) > self.max_pages:
+            raise ValueError(
+                f"slot {slot}: {len(pages)} pages > max_pages {self.max_pages}"
+            )
+        self._lists[slot] = pages
+        self.table[slot, :] = SCRATCH_PAGE
+        self.table[slot, : len(pages)] = pages
+        self.dirty = True
+
+    def grow(self, slot: int, page: int) -> None:
+        """Append one page to a slot's list (decode crossed a boundary)."""
+        lst = self._lists.setdefault(slot, [])
+        if len(lst) >= self.max_pages:
+            raise ValueError(f"slot {slot} already holds max_pages")
+        self.table[slot, len(lst)] = int(page)
+        lst.append(int(page))
+        self.dirty = True
+
+    def clear(self, slot: int) -> List[int]:
+        """Drop a slot's list (release/preempt); returns the pages so the
+        caller can hand them back to the allocator. The table row is zeroed
+        so a released row's masked device writes land on the scratch page,
+        never on a re-allocated one."""
+        pages = self._lists.pop(slot, [])
+        self.table[slot, :] = SCRATCH_PAGE
+        self.dirty = True
+        return pages
+
+    def row(self, slot: int) -> np.ndarray:
+        return self.table[slot].copy()
+
+    def check_invariants(self, allocator: BlockAllocator) -> None:
+        seen: Dict[int, int] = {}
+        for slot, pages in self._lists.items():
+            assert len(set(pages)) == len(pages), f"slot {slot} repeats a page"
+            row = self.table[slot]
+            assert list(row[: len(pages)]) == pages
+            assert all(p == SCRATCH_PAGE for p in row[len(pages):])
+            for p in pages:
+                seen[p] = seen.get(p, 0) + 1
+        for p, n in seen.items():
+            assert allocator.refcount(p) == n, (
+                f"page {p}: {n} list reference(s) vs refcount "
+                f"{allocator.refcount(p)}"
+            )

@@ -67,6 +67,13 @@ class Request:
     deadline_ts: Optional[float] = None
     # set by cancel(); the scheduler enacts it at the next loop boundary
     cancel_requested: bool = False
+    # disaggregated serving (docs/fleet.md): a KV pack from a prefill
+    # replica — the decode engine admits from it instead of prefilling.
+    # Consumed on first admission; a preempted request re-prefills locally.
+    prefilled: Optional[Dict[str, Any]] = None
+    # times this request was preempted for pages (docs/serving.md); its
+    # generated tokens are retained and re-admission resumes byte-identically
+    preemptions: int = 0
 
     @property
     def ttft_ms(self) -> Optional[float]:

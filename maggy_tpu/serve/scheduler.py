@@ -37,6 +37,7 @@ from maggy_tpu import telemetry
 from maggy_tpu.exceptions import BadArgumentsError
 from maggy_tpu.serve import request as rq
 from maggy_tpu.serve.engine import Engine
+from maggy_tpu.serve.paging import OutOfPagesError
 from maggy_tpu.serve.request import Request, SamplingParams
 from maggy_tpu.telemetry import flightrec, tracing
 from maggy_tpu.telemetry.histogram import LatencyHistogram
@@ -107,6 +108,9 @@ class Scheduler:
         self.slo_miss = 0
         self._started_ts = time.time()
         self._tok_rate_ema = 0.0
+        # paged-cache preemptions enacted (docs/serving.md "Preemption") —
+        # not a terminal state: the preempted request completes later
+        self.preemptions = 0
         self.counters: Dict[str, int] = {
             "submitted": 0,
             "done": 0,
@@ -125,6 +129,7 @@ class Scheduler:
         params: Optional[SamplingParams] = None,
         deadline_s: Optional[float] = None,
         trace: Optional[str] = None,
+        _pack: Optional[Dict[str, Any]] = None,
     ) -> Request:
         params = params or SamplingParams()
         params.validate()
@@ -135,7 +140,21 @@ class Scheduler:
                 f"prompt ({len(prompt)}) + max_new ({params.max_new}) "
                 f"exceeds max_seq_len ({self.engine.max_seq_len})"
             )
-        req = Request(prompt=[int(t) for t in prompt], params=params)
+        engine = self.engine
+        if engine.paged:
+            # a request that can NEVER fit the pool is a config error and
+            # fails fast; anything that fits eventually is admitted
+            # eventually (backpressure/preemption, never a refusal)
+            worst = -(-(len(prompt) + params.max_new) // engine.page_size)
+            cap = min(engine.max_pages_per_req, engine.allocator.pages_total)
+            if worst > cap:
+                raise BadArgumentsError(
+                    f"request needs up to {worst} KV pages > cap {cap} "
+                    f"(page_size {engine.page_size}; raise "
+                    "max_pages_per_req or the pool)"
+                )
+        req = Request(prompt=[int(t) for t in prompt], params=params,
+                      prefilled=_pack)
         # adopt the caller's trace id (SUBMIT frame / ambient RPC scope) so
         # the request's lifecycle correlates with its client-side journey;
         # direct in-process submits get a fresh one
@@ -157,6 +176,25 @@ class Scheduler:
             plen=len(req.prompt), max_new=params.max_new,
         )
         return req
+
+    def submit_prefilled(
+        self,
+        prompt: List[int],
+        params: Optional[SamplingParams],
+        pack: Dict[str, Any],
+        deadline_s: Optional[float] = None,
+        trace: Optional[str] = None,
+    ) -> Request:
+        """Disaggregated handoff entry (docs/fleet.md "Disaggregated
+        prefill/decode"): like :meth:`submit`, but the prompt's KV was
+        already computed by a prefill replica and rides in ``pack``
+        (:meth:`Engine.prefill_only`'s host-resident row). Admission writes
+        the pack into the cache instead of prefilling; everything after
+        the first token is the ordinary decode path."""
+        return self.submit(
+            prompt, params, deadline_s=deadline_s, trace=trace,
+            _pack=dict(pack),
+        )
 
     def poll(self, request_id: str) -> Dict[str, Any]:
         with self._lock:
@@ -244,6 +282,8 @@ class Scheduler:
                 "steps": engine.steps,
                 "uptime_s": round(time.time() - self._started_ts, 3),
                 "compile_counts": engine.compile_counts,
+                "paging": engine.paging_stats,
+                "preemptions": self.preemptions,
                 **engine.prefix_stats,
             }
         ttft = hists["ttft_ms"]
@@ -347,7 +387,13 @@ class Scheduler:
         return False
 
     def _admit_ready(self, now: float) -> None:
-        """Admit queued requests into free slots, FCFS; drop dead ones."""
+        """Admit queued requests into free slots, FCFS; drop dead ones.
+
+        A dry page pool (:class:`OutOfPagesError`) is BACKPRESSURE, not
+        failure: the head request goes back to the queue front and
+        admission pauses until running requests finish or preemption frees
+        pages — no request is ever refused for memory pressure (only a
+        request that could never fit fails, at submit)."""
         if self._pending_slots is not None:
             return  # drain-and-reconfigure in progress: let the wave empty
         while self.engine.slots.free_slots():
@@ -370,7 +416,13 @@ class Scheduler:
             # index match admit() itself will make
             req.admitted_ts = time.time()
             wait_ms = req.queue_wait_ms
-            prefix_hit = self.engine._match_prefix(req.prompt) is not None
+            prefix_hit = (
+                req.prefilled is None
+                and self.engine._match_prefix(
+                    list(req.prompt) + list(req.tokens)
+                )
+                is not None
+            )
             tel = self.telemetry
             if wait_ms is not None:
                 self._hist["queue_wait_ms"].observe(wait_ms)
@@ -383,7 +435,16 @@ class Scheduler:
                 # the request's trace becomes ambient for the admission, so
                 # the engine's prefill/prefix-admit spans correlate with it
                 with tracing.scope(req.trace):
-                    slot, first = self.engine.admit(req)
+                    if req.prefilled is not None:
+                        pack, req.prefilled = req.prefilled, None
+                        slot, first = self.engine.admit_from_kv(req, pack)
+                    else:
+                        slot, first = self.engine.admit(req)
+            except OutOfPagesError:
+                # pool dry: head of the line waits (ahead of everything)
+                with self._wake:
+                    self._queue.appendleft(req)
+                return
             except Exception as e:  # noqa: BLE001 - a poison request must not kill the loop
                 with self._lock:
                     self._finish(req, rq.FAILED, f"{type(e).__name__}: {e}")
@@ -391,20 +452,86 @@ class Scheduler:
             with self._lock:
                 req.state = rq.RUNNING
                 if self._emit(req, first, time.time()):
-                    self.engine.release(slot)
+                    self._release_slot(slot)
+
+    def _release_slot(self, slot: int) -> None:
+        """THE slot-vacating seam: every exit path (finish at emit, cancel,
+        deadline, preemption) releases cache resources — pages, prefix
+        anchor, slot row — through the engine's one release method. The
+        cancel-storm regression in test_serve_engine.py asserts nothing
+        leaks whichever path fires."""
+        self.engine.release(slot)
+
+    def _finish_active(
+        self, slot: int, req: Request, state: str, error: Optional[str] = None
+    ) -> None:
+        """Finish an in-slot request and release its resources — the shared
+        cancel/expire path (the emit path finishes inside ``_emit`` and
+        releases through the same ``_release_slot``)."""
+        with self._lock:
+            self._finish(req, state, error)
+        self._release_slot(slot)
 
     def _sweep_active(self, now: float) -> None:
         """Evict running requests whose cancel flag or deadline fired."""
         for slot in list(self.engine.slots.active_slots()):
             req = self.engine.slots.get(slot).request
             if req.cancel_requested:
-                with self._lock:
-                    self._finish(req, rq.CANCELLED)
-                self.engine.release(slot)
+                self._finish_active(slot, req, rq.CANCELLED)
             elif req.deadline_ts is not None and now > req.deadline_ts:
-                with self._lock:
-                    self._finish(req, rq.EXPIRED, "deadline exceeded while decoding")
-                self.engine.release(slot)
+                self._finish_active(
+                    slot, req, rq.EXPIRED, "deadline exceeded while decoding"
+                )
+
+    def _drain_inflight(self) -> None:
+        """Flush the async double buffer and emit what it held (preemption
+        prelude: the in-flight tokens may finish requests and free pages)."""
+        out = self.engine.flush()
+        now = time.time()
+        for slot, token in out.tokens.items():
+            req = self.engine.slots.get(slot).request
+            with self._lock:
+                finished = self._emit(req, token, now)
+            if finished:
+                self._release_slot(slot)
+
+    def _preempt_for_pages(self) -> None:
+        """Paged decode ran the allocator dry (an active row crossed a page
+        boundary with no free page): preempt the YOUNGEST active request —
+        free its pages, requeue it at the FRONT of the queue with prompt
+        AND generated tokens retained — until every remaining row can grow.
+        Re-admission resumes the stream byte-identically
+        (docs/serving.md "Preemption"); admission order still favors the
+        preempted request over fresh arrivals."""
+        if not self.engine.paged:
+            return
+        while self.engine.prepare_step():
+            # in-flight tokens first: a finish is cheaper than a preempt
+            self._drain_inflight()
+            if not self.engine.prepare_step():
+                return
+            actives = self.engine.slots.active_slots()
+            if not actives:
+                return
+            victim = max(
+                actives,
+                key=lambda s: (
+                    self.engine.slots.get(s).request.admitted_ts or 0.0,
+                    s,
+                ),
+            )
+            req = self.engine.slots.get(victim).request
+            self._release_slot(victim)
+            with self._wake:
+                req.state = rq.QUEUED
+                req.preemptions += 1
+                self._queue.appendleft(req)
+                self.preemptions += 1
+            self.telemetry.count("serve.preemptions")
+            self.telemetry.event(
+                "req.preempted", trace=req.trace, rid=req.id,
+                n_tokens=len(req.tokens), preemptions=req.preemptions,
+            )
 
     def _retire_old(self, now: float) -> None:
         with self._lock:
@@ -439,6 +566,7 @@ class Scheduler:
             if self.autopilot is not None:
                 self.autopilot.maybe_sample(now)
 
+            self._preempt_for_pages()
             active = self.engine.slots.active_slots()
             if active:
                 t0 = time.perf_counter()
@@ -450,7 +578,7 @@ class Scheduler:
                     with self._lock:
                         finished = self._emit(req, token, now)
                     if finished:
-                        self.engine.release(slot)
+                        self._release_slot(slot)
                 rate = len(out.tokens) / dt if dt > 0 else 0.0
                 self._tok_rate_ema = (
                     rate if self._tok_rate_ema == 0.0

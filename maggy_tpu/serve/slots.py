@@ -48,9 +48,20 @@ class SlotManager:
     def free_slots(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
 
-    def admit(self, request: Request, first_token: int) -> int:
+    def admit(
+        self,
+        request: Request,
+        first_token: int,
+        next_pos: Optional[int] = None,
+        generated: int = 1,
+    ) -> int:
         """Claim a free slot for ``request`` whose prompt was just prefilled
-        and whose first token was sampled from the prefill logits."""
+        and whose first token was sampled from the prefill logits.
+
+        ``next_pos``/``generated`` override the fresh-request defaults for
+        PREEMPTED requests being re-admitted (docs/serving.md "Preemption"):
+        their prefill covered prompt + already-generated tokens, so the
+        write position and the PRNG fold-in index resume mid-stream."""
         free = self.free_slots()
         if not free:
             raise SlotOccupiedError("no free slot")
@@ -59,9 +70,9 @@ class SlotManager:
         slot = free[0]
         self._slots[slot] = SlotState(
             request=request,
-            next_pos=len(request.prompt),
+            next_pos=len(request.prompt) if next_pos is None else int(next_pos),
             last_token=int(first_token),
-            generated=1,
+            generated=int(generated),
         )
         self._by_request[request.id] = slot
         return slot

@@ -48,8 +48,12 @@ GAUGES = frozenset(
         "serve.drain_ms",
         "serve.decode_retraces",
         "serve.prefill_retraces",
+        # paged KV cache (serve/paging/, docs/serving.md "Paged KV cache")
+        "serve.pages_free",  # allocatable pages left in the pool
+        "serve.pages_shared",  # pages aliased by >1 request (prefix reuse)
         # serving fleet (serve/fleet/)
         "fleet.healthy_replicas",
+        "serve.handoff_ms",  # prefill->decode KV handoff latency
         # autotuner (tune/)
         "tune.candidates",
         "tune.pruned_oom",
@@ -71,6 +75,7 @@ COUNTERS = frozenset(
         "checkpoint_fallback",
         "serve.prefix_hits",
         "serve.prefix_tokens_saved",
+        "serve.preemptions",  # paged-pool preemptions (request requeued, not failed)
         "fleet.shed",
         "fleet.quarantined",
         "fleet.requeued",
@@ -105,6 +110,7 @@ HISTOGRAMS = frozenset(
         "serve.queue_wait_ms",  # submit -> admission
         "serve.e2e_ms",  # submit -> terminal state
         "serve.drain_ms",  # async decode host drain
+        "serve.handoff_ms",  # disaggregated prefill->decode handoff
     }
 )
 
@@ -117,12 +123,16 @@ EVENTS = frozenset(
         "req.prefix_admitted",
         "req.first_token",
         "req.finished",
+        "req.preempted",  # pages freed, requeued ahead of fresh arrivals
         # router-side hops (serve/fleet/router.py)
         "req.accepted",
         "req.dispatched",
         "req.requeued",
         "req.shed",
         "req.completed",
+        # disaggregated prefill/decode (serve/fleet/prefill.py, docs/fleet.md)
+        "req.prefilled",  # prompt ran on a prefill replica
+        "req.handoff",  # KV pack accepted by a decode replica
         # training runs (train/trainer.py)
         "train.run_start",
         "train.run_end",

@@ -1,0 +1,534 @@
+"""Paged KV cache + disaggregated prefill/decode (ISSUE 10).
+
+Acceptance: paged is the default and byte-identical to dense (greedy AND
+sampled) with the decode step still compiling once across churn; prefix
+admission aliases ref-counted pages with ZERO rewrites of shared pages;
+preemption under allocator pressure completes every request byte-identically
+(requeued ahead of fresh arrivals, never failed); a 1-prefill + 1-decode
+fleet serves the PR 6 workload byte-identical to a single engine with
+``req.prefilled``/``req.handoff`` events on each request's trace lane.
+Property tests hammer the allocator/page-table invariants (no double-free,
+shared pages never written in place, atomic alloc, fragmentation soak).
+"""
+
+import dataclasses
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maggy_tpu import telemetry
+from maggy_tpu.exceptions import BadArgumentsError
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.models.generate import generate_cached
+from maggy_tpu.parallel.sharding import unbox
+from maggy_tpu.serve import (
+    BlockAllocator,
+    Engine,
+    OutOfPagesError,
+    PageTable,
+    Request,
+    SamplingParams,
+    Scheduler,
+)
+
+CFG = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+PAGE = 16  # engine default page size; 4 pages per max_seq_len row here
+SYS = list(range(100, 133))  # 33-token system prompt: 2 full pages shared
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Decoder(CFG)
+    return unbox(
+        model.init(jax.random.key(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+
+
+def reference(params, prompt, max_new):
+    decode_model = Decoder(dataclasses.replace(CFG, decode=True))
+    buf = np.zeros((1, len(prompt) + max_new), np.int32)
+    buf[0, : len(prompt)] = prompt
+    out = generate_cached(
+        decode_model, params, jnp.asarray(buf), jnp.asarray([len(prompt)])
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+def run_scheduler(params, jobs, timeout=90, **engine_kw):
+    """Submit (prompt, SamplingParams) jobs, run to completion; returns
+    (token streams in submit order, engine, scheduler stats)."""
+    engine = Engine(CFG, params, **engine_kw)
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        reqs = [scheduler.submit(p, sp) for p, sp in jobs]
+        deadline = time.time() + timeout
+        while time.time() < deadline and any(
+            r.state not in ("done", "failed", "cancelled", "expired")
+            for r in reqs
+        ):
+            time.sleep(0.01)
+        assert all(r.state == "done" for r in reqs), [
+            (r.state, r.error) for r in reqs
+        ]
+        stats = scheduler.stats()
+    finally:
+        scheduler.stop()
+    return [list(r.tokens) for r in reqs], engine, stats
+
+
+def pool_leaf(cache, name="k"):
+    """The (first) named cache pool leaf."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if jax.tree_util.keystr(path).endswith(f"['{name}']"):
+            return leaf
+    raise AssertionError(f"no {name!r} leaf")
+
+
+# ---------------------------------------------------------- allocator units
+
+
+def test_allocator_alloc_free_refcount():
+    a = BlockAllocator(num_pages=9, page_size=PAGE)
+    assert a.pages_total == 8 and a.pages_free == 8
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got  # scratch page never allocated
+    assert a.pages_free == 5 and all(a.refcount(p) == 1 for p in got)
+    # aliasing: refcount 2, shows in pages_shared, release is two-step
+    a.share(got[:2])
+    assert a.pages_shared == 2 and a.refcount(got[0]) == 2
+    assert a.release(got[:2]) == 0  # still referenced: nothing freed
+    assert a.pages_shared == 0 and a.refcount(got[0]) == 1
+    assert a.release(got) == 3
+    assert a.pages_free == 8
+    a.check_invariants()
+
+
+def test_allocator_atomic_and_errors():
+    a = BlockAllocator(num_pages=5, page_size=PAGE)  # 4 usable
+    got = a.alloc(3)
+    with pytest.raises(OutOfPagesError):
+        a.alloc(2)  # only 1 free: all-or-nothing
+    assert a.pages_free == 1, "failed alloc must not leak pages"
+    with pytest.raises(ValueError, match="double free"):
+        a.release([got[0], got[0], got[0]])  # refs 1 -> freed -> double
+    with pytest.raises(ValueError, match="unallocated"):
+        a.share([0])  # scratch page is never shareable
+    free_page = a.alloc(1)[0]
+    a.release([free_page])
+    with pytest.raises(ValueError, match="unallocated"):
+        a.share([free_page])
+    a.check_invariants()
+
+
+def test_page_table_mirror():
+    a = BlockAllocator(num_pages=9, page_size=PAGE)
+    t = PageTable(num_slots=2, max_pages=4)
+    pages = a.alloc(2)
+    t.assign(0, pages)
+    assert list(t.table[0]) == pages + [0, 0]
+    grown = a.alloc(1)[0]
+    t.grow(0, grown)
+    assert t.count(0) == 3 and t.pages(0) == pages + [grown]
+    t.check_invariants(a)
+    # clear zeroes the row (released rows' masked writes hit scratch)
+    freed = t.clear(0)
+    assert freed == pages + [grown] and not t.table[0].any()
+    a.release(freed)
+    t.check_invariants(a)
+    a.check_invariants()
+
+
+@pytest.mark.slow
+def test_allocator_fragmentation_soak():
+    """Random alloc/share/release churn never breaks the invariants and
+    never strands a page (free + referenced == total throughout)."""
+    rng = random.Random(0)
+    a = BlockAllocator(num_pages=33, page_size=8)
+    held = []  # lists of (pages, aliased_from_held_index)
+    for _ in range(2000):
+        op = rng.random()
+        if op < 0.45 and a.pages_free:
+            n = rng.randint(1, min(4, a.pages_free))
+            held.append(a.alloc(n))
+        elif op < 0.6 and held:
+            src = rng.choice(held)
+            take = src[: rng.randint(1, len(src))]
+            a.share(take)
+            held.append(list(take))
+        elif held:
+            idx = rng.randrange(len(held))
+            a.release(held.pop(idx))
+        a.check_invariants()
+    for pages in held:
+        a.release(pages)
+    assert a.pages_free == a.pages_total
+    a.check_invariants()
+
+
+# ------------------------------------------------------------- byte parity
+
+
+def test_paged_is_default_and_matches_dense(params):
+    """ACCEPTANCE: the paged path is the default, byte-identical to dense
+    for greedy AND sampled streams under staggered churn, and the decode
+    step compiles exactly once."""
+    assert Engine(CFG, params).paged, "paged must be the default"
+    for temp in (0.0, 0.8):
+        jobs = [
+            (p, SamplingParams(max_new=4 + i % 3, temperature=temp, seed=11 + i))
+            for i, p in enumerate(
+                [[1, 2, 3, 4], [5, 6, 7], [9, 10, 11, 12, 13], [2, 4, 6], [7, 3]]
+            )
+        ]
+        dense, _, _ = run_scheduler(params, jobs, num_slots=3, paged=False)
+        paged, engine, stats = run_scheduler(
+            params, jobs, num_slots=3, paged=True
+        )
+        assert dense == paged, f"temp={temp}: paged diverges from dense"
+        assert engine.compile_counts["decode"] == 1, engine.compile_counts
+        if temp == 0.0:
+            for (prompt, sp), stream in zip(jobs, paged):
+                assert stream == reference(params, prompt, sp.max_new)
+    # all pages returned once the wave drained
+    assert engine.allocator.pages_free == engine.allocator.pages_total
+    assert stats["paging"]["paged"] is True
+
+
+def test_prefix_alias_shares_pages_zero_copy(params):
+    """ACCEPTANCE: prefix admission on a shared-system-prompt workload
+    aliases the shared FULL pages — refcount > 1, ``pages_shared`` > 0,
+    and the pool bytes at the aliased pages are bit-for-bit untouched
+    (zero KV row copies) — while outputs stay byte-identical."""
+    engine = Engine(CFG, params, num_slots=4, paged=True)
+    s0, _ = engine.admit(
+        Request(prompt=SYS + [1, 2], params=SamplingParams(max_new=4))
+    )
+    anchor_pages = engine.page_table.pages(s0)
+    shared_full = anchor_pages[: len(SYS) // PAGE]
+    assert len(shared_full) == 2
+    before_k = np.asarray(pool_leaf(engine.cache)[:, shared_full])
+    before_v = np.asarray(pool_leaf(engine.cache, "v")[:, shared_full])
+
+    s1, first = engine.admit(
+        Request(prompt=SYS + [7, 8, 9], params=SamplingParams(max_new=4))
+    )
+    assert engine.prefix_hits == 1
+    assert engine.pages_aliased == 2
+    assert engine.page_table.pages(s1)[:2] == shared_full
+    assert all(engine.allocator.refcount(p) == 2 for p in shared_full)
+    assert engine.allocator.pages_shared == 2
+    assert np.array_equal(
+        before_k, np.asarray(pool_leaf(engine.cache)[:, shared_full])
+    ), "shared K pages were rewritten (copy-on-write violated)"
+    assert np.array_equal(
+        before_v, np.asarray(pool_leaf(engine.cache, "v")[:, shared_full])
+    )
+
+    # the aliased request decodes byte-identically to a fresh reference
+    stream = [first]
+    while len(stream) < 4:
+        out = engine.step()
+        if s1 in out.tokens:
+            stream.append(out.tokens[s1])
+    assert stream == reference(params, SYS + [7, 8, 9], 4)
+
+    # releasing the ANCHOR keeps the shared pages alive for the sharer;
+    # releasing the sharer finally frees them
+    engine.release(s0)
+    assert all(engine.allocator.refcount(p) == 1 for p in shared_full)
+    engine.release(s1)
+    engine.flush()
+    assert engine.allocator.pages_free == engine.allocator.pages_total
+    engine.allocator.check_invariants()
+    engine.page_table.check_invariants(engine.allocator)
+
+
+# -------------------------------------------------------------- preemption
+
+
+def test_preemption_completes_byte_identical(params):
+    """ACCEPTANCE (chaos): a pool too small for the offered load preempts
+    the youngest request instead of refusing/failing — every request
+    completes, streams are byte-identical to an unpressured run, and no
+    page leaks."""
+    # 14-token prompts fit one page; max_new=12 grows each row to 2 pages
+    # mid-decode: 3 rows x 2 pages > 5 usable pages -> growth must preempt
+    jobs = [
+        (list(range(1 + i, 15 + i)),
+         SamplingParams(max_new=12, temperature=0.7, seed=i))
+        for i in range(3)
+    ]
+    tel = telemetry.Telemetry(worker="preempt-test")
+    engine = Engine(
+        CFG, params, num_slots=3, paged=True, num_pages=6,
+        telemetry_recorder=tel,
+    )
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        reqs = [scheduler.submit(p, sp) for p, sp in jobs]
+        deadline = time.time() + 90
+        while time.time() < deadline and any(
+            r.state not in ("done", "failed") for r in reqs
+        ):
+            time.sleep(0.01)
+        assert all(r.state == "done" for r in reqs), [
+            (r.state, r.error) for r in reqs
+        ]
+        tight = [list(r.tokens) for r in reqs]
+        preemptions = scheduler.preemptions
+        stats = scheduler.stats()
+    finally:
+        scheduler.stop()
+    assert preemptions >= 1, "pressure did not preempt"
+    assert stats["preemptions"] == preemptions
+    free, _, _ = run_scheduler(params, jobs, num_slots=3, paged=True)
+    assert tight == free, "preemption changed token streams"
+    assert engine.allocator.pages_free == engine.allocator.pages_total
+    engine.allocator.check_invariants()
+    # observability: the counter and the lifecycle event both fired
+    snap = tel.snapshot()
+    assert snap["counters"].get("serve.preemptions") == preemptions
+    names = [e["name"] for e in tel.drain_events()]
+    assert "req.preempted" in names
+
+
+def test_pool_backpressure_and_impossible_request(params):
+    """Memory pressure never FAILS a request: admission backpressures until
+    pages free up. Only a request that could NEVER fit fails, at submit."""
+    engine = Engine(CFG, params, num_slots=4, paged=True, num_pages=4)
+    scheduler = Scheduler(engine)
+    # 3 usable pages total: a 40-token prompt + 24 new needs 4 -> impossible
+    with pytest.raises(BadArgumentsError, match="pages"):
+        scheduler.submit(list(range(1, 41)), SamplingParams(max_new=24))
+    scheduler.start()
+    try:
+        # each needs 2 pages; only one fits at a time beside another
+        reqs = [
+            scheduler.submit(
+                list(range(10 * i + 1, 10 * i + 20)), SamplingParams(max_new=8)
+            )
+            for i in range(4)
+        ]
+        deadline = time.time() + 90
+        while time.time() < deadline and any(
+            r.state not in ("done", "failed") for r in reqs
+        ):
+            time.sleep(0.01)
+        assert all(r.state == "done" for r in reqs), [
+            (r.state, r.error) for r in reqs
+        ]
+    finally:
+        scheduler.stop()
+    assert engine.allocator.pages_free == engine.allocator.pages_total
+
+
+def test_max_pages_per_req_knob(params):
+    """The live ``serve.max_pages_per_req`` cap rejects oversized requests
+    at submit and is applied through the autopilot target seam."""
+    from maggy_tpu.autopilot.controller import SchedulerTarget
+
+    engine = Engine(CFG, params, num_slots=2, paged=True)
+    scheduler = Scheduler(engine)
+    target = SchedulerTarget(scheduler)
+    cur = target.current()
+    assert cur["serve.page_size"] == engine.page_size
+    assert cur["serve.max_pages_per_req"] == engine.pages_per_row
+    assert target.apply("serve.max_pages_per_req", 1)
+    assert engine.max_pages_per_req == 1
+    with pytest.raises(BadArgumentsError, match="max_pages_per_req"):
+        scheduler.submit(list(range(1, 15)), SamplingParams(max_new=10))
+    scheduler.submit(list(range(1, 9)), SamplingParams(max_new=4))  # 12 tok: fits
+
+
+def test_planner_shrinks_pages_before_slots():
+    """Satellite: the memory-bound serve playbook shrinks pages-per-request
+    BEFORE shrinking num_slots."""
+    from maggy_tpu.autopilot.diagnose import Diagnosis
+    from maggy_tpu.autopilot.plan import Planner
+
+    diag = Diagnosis(
+        bottleneck="memory_bound", scope="serve", evidence={}, shares={},
+        reason="test",
+    )
+    moves = Planner().plan(
+        diag,
+        {"serve.num_slots": 8, "serve.max_pages_per_req": 4},
+    )
+    assert [m.knob for m in moves] == [
+        "serve.max_pages_per_req",
+        "serve.num_slots",
+    ]
+    assert moves[0].value == 2 and moves[1].value == 4
+
+
+# ---------------------------------------------------- concurrency at budget
+
+
+def test_concurrency_doubles_at_fixed_page_budget(params):
+    """At an equal simulated HBM budget (dense_slots full rows' worth of
+    pages), the paged engine admits >= 2x the dense slot count of
+    typical-length requests concurrently."""
+    dense_slots = 2
+    budget = dense_slots * (CFG.max_seq_len // PAGE)  # 8 pages
+    engine = Engine(
+        CFG, params, num_slots=16, paged=True, num_pages=budget + 1
+    )
+    resident = 0
+    # 12-token requests (1 page now, 2 worst-case) admit until pages run out
+    for i in range(16):
+        try:
+            engine.admit(
+                Request(
+                    prompt=[1 + i, 2, 3, 4],
+                    params=SamplingParams(max_new=8),
+                )
+            )
+            resident += 1
+        except OutOfPagesError:
+            break
+    assert resident >= 2 * dense_slots, (resident, dense_slots)
+
+
+# ------------------------------------------------------------ reconfigure
+
+
+def test_reconfigure_rebuilds_paged_pool(params):
+    """Drain-and-reconfigure on a paged engine rebuilds the allocator and
+    pool at the new geometry and still decodes byte-identically."""
+    engine = Engine(CFG, params, num_slots=2, paged=True)
+    engine.reconfigure(4)
+    assert engine.slots.num_slots == 4
+    assert engine.allocator.pages_total == 4 * engine.pages_per_row
+    slot, first = engine.admit(
+        Request(prompt=[1, 2, 3], params=SamplingParams(max_new=4))
+    )
+    stream = [first]
+    while len(stream) < 4:
+        out = engine.step()
+        if slot in out.tokens:
+            stream.append(out.tokens[slot])
+    assert stream == reference(params, [1, 2, 3], 4)
+
+
+# --------------------------------------------------- disaggregated serving
+
+
+def test_disaggregated_fleet_byte_identical(params):
+    """ACCEPTANCE: a 2-replica disaggregated fleet (1 prefill + 1 decode)
+    serves the PR 6 workload byte-identical to a single engine, with
+    ``req.prefilled`` and ``req.handoff`` events visible on each request's
+    trace lane and the handoff latency in the histogram registry."""
+    from maggy_tpu.monitor import render_status
+    from maggy_tpu.serve import ServeClient
+    from maggy_tpu.serve.fleet import ReplicaSpec, launch_fleet
+
+    prompts = [
+        [1, 2, 3, 4], [5, 6, 7], [9, 10, 11, 12, 13],
+        [2, 4, 6, 8, 10, 12], [7, 3], [40, 41, 42],
+        [1, 2, 3, 4, 5], [6, 5, 4],
+    ]
+    tel = telemetry.Telemetry(worker="router-test")
+    spec = ReplicaSpec(CFG, params, num_slots=4)
+    router = launch_fleet(
+        spec, replicas=1, prefill_replicas=1, secret="s",
+        telemetry_recorder=tel,
+    )
+    host, port = router.start(host="127.0.0.1")
+    client = ServeClient(("127.0.0.1", port), "s")
+    try:
+        ids = [
+            client.submit(p, max_new=6, seed=i)
+            for i, p in enumerate(prompts)
+        ]
+        streams, traces = [], []
+        for rid in ids:
+            deadline = time.time() + 90
+            snap = None
+            while time.time() < deadline:
+                snap = client.poll(rid)
+                if snap.get("done"):
+                    break
+                time.sleep(0.02)
+            assert snap and snap.get("state") == "done", snap
+            streams.append(snap["tokens"])
+            traces.append(snap["trace"])
+        stats = client.stats()
+        status = client._call({"type": "STATUS"})
+    finally:
+        client.close()
+        router.stop()
+
+    jobs = [(p, SamplingParams(max_new=6, seed=i)) for i, p in enumerate(prompts)]
+    single, _, _ = run_scheduler(params, jobs, num_slots=4)
+    assert streams == single, "disaggregated fleet diverges from one engine"
+
+    assert stats["routing"]["prefilled"] == len(prompts)
+    assert stats["routing"]["handoffs"] == len(prompts)
+    # every request's trace lane carries the prefill + handoff milestones
+    events = tel.drain_events()
+    for trace in traces:
+        lane = {e["name"] for e in events if e.get("trace") == trace}
+        assert "req.prefilled" in lane and "req.handoff" in lane, lane
+    # handoff latency reaches the histogram + gauge surfaces
+    snap = tel.snapshot()
+    assert "serve.handoff_ms" in snap.get("hist", {})
+    assert "serve.handoff_ms" in snap.get("gauges", {})
+    # fleet panel renders roles and handoff counters
+    panel = render_status(status)
+    assert "prefill" in panel and "handoffs=" in panel, panel
+
+
+def test_prefill_worker_fallback(params):
+    """A dead prefill replica degrades to plain dispatch — requests still
+    complete (the decode replica prefills for itself)."""
+    from maggy_tpu.serve import ServeClient
+    from maggy_tpu.serve.fleet import ReplicaSpec, launch_fleet
+
+    spec = ReplicaSpec(CFG, params, num_slots=4)
+    router = launch_fleet(spec, replicas=1, prefill_replicas=1, secret="s")
+    host, port = router.start(host="127.0.0.1")
+    client = ServeClient(("127.0.0.1", port), "s")
+    try:
+        # kill the prefill replica (the last one built)
+        prefill_replica = router.prefill_workers[0].replica
+        prefill_replica.kill()
+        rid = client.submit([1, 2, 3, 4], max_new=4)
+        deadline = time.time() + 60
+        snap = None
+        while time.time() < deadline:
+            snap = client.poll(rid)
+            if snap.get("done"):
+                break
+            time.sleep(0.02)
+        assert snap and snap["state"] == "done", snap
+        assert snap["tokens"] == reference(params, [1, 2, 3, 4], 4)
+    finally:
+        client.close()
+        router.stop()
+
+
+# ------------------------------------------------------------- panel/stats
+
+
+def test_paging_stats_and_serve_panel(params):
+    """`paging` in scheduler stats and the pages line on the serve panel."""
+    from maggy_tpu.monitor import render_status
+
+    engine = Engine(CFG, params, num_slots=2, paged=True)
+    scheduler = Scheduler(engine)
+    stats = scheduler.stats()
+    paging = stats["paging"]
+    assert paging["paged"] and paging["page_size"] == PAGE
+    assert paging["pages_free"] == paging["pages_total"]
+    status = {
+        "name": "t", "kind": "serve", "state": "serving",
+        "app_id": "t", "run_id": 0, "serve": stats,
+    }
+    panel = render_status(status)
+    assert "pages" in panel, panel

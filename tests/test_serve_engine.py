@@ -343,6 +343,60 @@ def test_submit_validates_length_and_params(served):
         scheduler.submit([1], SamplingParams(max_new=0))
 
 
+def test_cancel_storm_releases_all_resources(served):
+    """Satellite (ISSUE 10): cancel/deadline-expire release cache resources
+    through the ONE shared release path — after a storm of cancellations
+    (queued and mid-decode alike) plus deadline expiries, no slot and no
+    KV page leaks, and the engine still serves fresh work correctly."""
+    _, params = served
+    engine = Engine(CFG, params, num_slots=3)  # paged default
+    scheduler = Scheduler(engine)
+    scheduler.start()
+    try:
+        reqs = []
+        for i in range(12):
+            reqs.append(
+                scheduler.submit(
+                    [1 + i, 2, 3], SamplingParams(max_new=30),
+                    # a few die by deadline instead of cancel
+                    deadline_s=0.2 if i % 4 == 3 else None,
+                )
+            )
+        time.sleep(0.15)  # let some admit and decode
+        for i, r in enumerate(reqs):
+            if i % 4 != 3:
+                scheduler.cancel(r.id)
+        deadline = time.time() + 60
+        while time.time() < deadline and any(
+            r.state not in ("done", "cancelled", "expired", "failed")
+            for r in reqs
+        ):
+            time.sleep(0.01)
+        assert not any(r.state == "failed" for r in reqs), [
+            (r.state, r.error) for r in reqs
+        ]
+        assert scheduler.drain(timeout=30)
+    finally:
+        scheduler.stop()
+    # the storm left nothing behind: no occupied slot, no referenced page
+    assert engine.slots.active_count == 0
+    engine.slots.check_invariants()
+    assert engine.paged
+    assert engine.allocator.pages_free == engine.allocator.pages_total, (
+        engine.allocator.stats()
+    )
+    engine.allocator.check_invariants()
+    engine.page_table.check_invariants(engine.allocator)
+    # and the engine still serves fresh requests byte-identically
+    slot, first = engine.admit(
+        Request(prompt=[1, 2, 3], params=SamplingParams(max_new=4))
+    )
+    toks = [first]
+    while len(toks) < 4:
+        toks.extend(engine.step().tokens.values())
+    assert toks == list(reference(params, [1, 2, 3], 4))
+
+
 def test_admit_without_free_slot_raises(served):
     _, params = served
     engine = make_engine(params, num_slots=1)
