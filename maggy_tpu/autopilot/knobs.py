@@ -44,6 +44,15 @@ REMAT_POLICY_CHOICES = (None, "nothing", "dots", "dots_attn")
 # supported max_seq_len; the engine snaps incompatible values down
 PAGE_SIZE_CHOICES = (8, 16, 32, 64, 128)
 
+# gradient-reduction bucket sizes in MiB (parallel/overlap.py): powers of
+# two spanning tiny test models up to production param trees; None =
+# unbucketed (one collective per dtype)
+BUCKET_MB_CHOICES = (None, 1, 4, 16, 32, 64, 128, 256)
+
+# ZeRO optimizer-state sharding stages supported by the trainer (0 = dense
+# replicated states, 1 = states sharded over the data axis)
+ZERO_STAGE_CHOICES = (0, 1)
+
 
 @dataclasses.dataclass(frozen=True)
 class Knob:
@@ -103,6 +112,19 @@ KNOBS = {
             "train.remat_policy", "choice", "train", False,
             "activation remat policy (startup-only)",
             choices=REMAT_POLICY_CHOICES,
+        ),
+        Knob(
+            "train.zero_stage", "choice", "train", False,
+            "ZeRO optimizer-state sharding stage (startup-only: changes the "
+            "optax state layout; memory-bound playbook raises it before "
+            "shrinking batch)",
+            choices=ZERO_STAGE_CHOICES,
+        ),
+        Knob(
+            "train.bucket_mb", "choice", "train", False,
+            "gradient-reduction bucket size in MiB (startup-only: None = "
+            "unbucketed; smaller buckets overlap more comm with backward)",
+            choices=BUCKET_MB_CHOICES,
         ),
         Knob(
             "train.flash_bwd_block_q", "choice", "train", False,

@@ -174,6 +174,13 @@ class Planner:
             if cur is not None:
                 moves.append(Move(knob.name, _grow(knob, cur), diag.reason))
         elif diag.bottleneck == "memory_bound":
+            # ZeRO first (docs/distributed.md "Gradient overlap & ZeRO"):
+            # sharding optimizer states over the data axis recovers
+            # ~2x param bytes per device WITHOUT touching the batch —
+            # shrink batch only when zero_stage is already raised (or the
+            # caller doesn't report it)
+            if current.get("train.zero_stage") == 0:
+                moves.append(Move("train.zero_stage", 1, diag.reason))
             bs = current.get("train.batch_size")
             if bs and int(bs) > 1:
                 moves.append(

@@ -29,6 +29,8 @@ class DistributedConfig(LagomConfig):
         mixed_precision: bool = True,
         remat: bool = False,
         zero_lvl: Optional[int] = None,
+        zero_stage: Optional[int] = None,
+        bucket_mb: Optional[float] = None,
         model: Any = None,
         process_data: Optional[Callable] = None,
         name: str = "tpuDist",
@@ -59,8 +61,16 @@ class DistributedConfig(LagomConfig):
             torch.cuda.amp, torch_distributed.py:58).
         :param remat: apply jax.checkpoint to layer stacks (activation
             rematerialization — trades FLOPs for HBM).
-        :param zero_lvl: migration shim: 0→dp, 1/2/3→fsdp (reference semantics,
-            torch_distributed.py:60-63). Overrides ``sharding`` when set.
+        :param zero_lvl: migration shim (reference semantics,
+            torch_distributed.py:60-63): 0→dp, 2/3→fsdp; 1→dp with the
+            native ZeRO-1 optimizer-state sharding (``zero_stage=1``) —
+            the reference's ZeRO-1 is exactly optimizer states sharded
+            over data parallelism. Overrides ``sharding`` when set.
+        :param zero_stage: native ZeRO stage (0/1) stamped onto the resolved
+            :class:`ShardingSpec` (docs/distributed.md "Gradient overlap &
+            ZeRO"); overrides the ``zero_lvl`` mapping when both are given.
+        :param bucket_mb: gradient-reduction bucket size in MiB stamped onto
+            the resolved spec (None = unbucketed).
         :param model: alias for ``module`` matching TfDistributedConfig's field name.
         :param process_data: optional callable applied to the dataset on each worker
             (tf_distributed.py:43 equivalent).
@@ -74,7 +84,15 @@ class DistributedConfig(LagomConfig):
         if zero_lvl is not None:
             if zero_lvl not in (0, 1, 2, 3):
                 raise ValueError("zero_lvl must be in 0..3")
-            sharding = "dp" if zero_lvl == 0 else "fsdp"
+            # ZeRO-1 is optimizer-state sharding over pure dp — now native
+            # (parallel/overlap.py) instead of approximated by fsdp
+            sharding = "dp" if zero_lvl in (0, 1) else "fsdp"
+            if zero_lvl == 1 and zero_stage is None:
+                zero_stage = 1
+        if zero_stage is not None and zero_stage not in (0, 1):
+            raise ValueError("zero_stage must be 0 or 1")
+        self.zero_stage = zero_stage
+        self.bucket_mb = bucket_mb
         self.sharding = sharding
         self.mixed_precision = bool(mixed_precision)
         self.remat = bool(remat)
@@ -148,8 +166,19 @@ class DistributedConfig(LagomConfig):
             )
 
     def resolve_sharding(self, num_devices: int) -> ShardingSpec:
+        import dataclasses
+
         if isinstance(self.sharding, ShardingSpec):
-            if self.sharding.num_devices != num_devices:
-                return self.sharding.scaled_to(num_devices)
-            return self.sharding
-        return ShardingSpec.preset(self.sharding, num_devices)
+            spec = (
+                self.sharding.scaled_to(num_devices)
+                if self.sharding.num_devices != num_devices
+                else self.sharding
+            )
+        else:
+            spec = ShardingSpec.preset(self.sharding, num_devices)
+        overrides = {}
+        if self.zero_stage is not None:
+            overrides["zero_stage"] = int(self.zero_stage)
+        if self.bucket_mb is not None:
+            overrides["bucket_mb"] = float(self.bucket_mb)
+        return dataclasses.replace(spec, **overrides) if overrides else spec

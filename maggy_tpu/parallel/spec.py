@@ -12,7 +12,7 @@ reference (§2.9).
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 # Canonical mesh-axis names, in layout-priority order. ICI-heavy axes (tensor, seq)
 # should map to the innermost/physically-closest devices; `stage` (pipeline:
@@ -36,6 +36,9 @@ MESH_AXES = (AXIS_STAGE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSO
 # multi-slice meshes prepend the slice axis; single-slice code never sees it
 SLICE_MESH_AXES = (AXIS_SLICE,) + MESH_AXES
 
+# ShardingSpec fields that are mesh-axis extents (the rest are tuning knobs)
+_AXIS_FIELDS = ("dp", "fsdp", "tp", "sp", "ep", "pp")
+
 
 @dataclasses.dataclass(frozen=True)
 class ShardingSpec:
@@ -51,6 +54,12 @@ class ShardingSpec:
     ``pp``    pipeline parallelism over layer stages (the reference explicitly
               rejects it, modules.py:106-109; provided here as
               parallel/pipeline.py)
+
+    Two non-axis knobs ride along (docs/distributed.md "Gradient overlap &
+    ZeRO"): ``zero_stage`` (0 or 1) shards optimizer state over the data
+    axis à la ZeRO-1 — the pure-dp complement of ``fsdp``, which already
+    shards it — and ``bucket_mb`` bounds the gradient-reduction bucket size
+    in MiB (None = unbucketed). Both default to the legacy dense behavior.
     """
 
     dp: int = 1
@@ -59,12 +68,25 @@ class ShardingSpec:
     sp: int = 1
     ep: int = 1
     pp: int = 1
+    zero_stage: int = 0
+    bucket_mb: Optional[float] = None
 
     def __post_init__(self):
         for f in dataclasses.fields(self):
+            if f.name not in _AXIS_FIELDS:
+                continue
             v = getattr(self, f.name)
             if not isinstance(v, int) or v < 1:
                 raise ValueError(f"ShardingSpec.{f.name} must be a positive int, got {v!r}")
+        if self.zero_stage not in (0, 1):
+            raise ValueError(
+                f"ShardingSpec.zero_stage must be 0 or 1, got {self.zero_stage!r}"
+            )
+        if self.bucket_mb is not None and not float(self.bucket_mb) > 0:
+            raise ValueError(
+                f"ShardingSpec.bucket_mb must be positive (or None), got "
+                f"{self.bucket_mb!r}"
+            )
 
     @property
     def num_devices(self) -> int:

@@ -58,6 +58,11 @@ GAUGES = frozenset(
         "tune.candidates",
         "tune.pruned_oom",
         "tune.best_step_time",
+        # gradient overlap + ZeRO (parallel/overlap.py, train/trainer.py;
+        # docs/distributed.md "Gradient overlap & ZeRO")
+        "train.bucket_count",  # gradient-reduction buckets in the compiled step
+        "train.comm_exposed_ms",  # comm time still on the critical path
+        "train.comm_overlapped_ms",  # comm time hidden under backward
         # autopilot online controller (autopilot/controller.py)
         "autopilot.tick_ms",  # per-sample controller cost (≤2% budget)
         # elastic membership (resilience/membership.py, core/driver/distributed.py)
@@ -92,6 +97,7 @@ COUNTERS = frozenset(
         "resilience.slice_rejoins",  # dropped slices re-admitted
         "resilience.reshape_checkpoints",  # graceful-reshape convergence saves
         "resilience.ckpt_reshards",  # restores re-placed across mesh layouts
+        "resilience.ckpt_zero_reshards",  # optimizer states converted across zero layouts
         "tune.cache_hits",
         "tune.cache_misses",
         "flightrec.dumps",  # stall watchdog dumps written (telemetry/flightrec.py)
@@ -154,6 +160,7 @@ DYNAMIC_PREFIXES = (
     "serve.requests_",  # scheduler terminal-state counters
     "rpc_errors.",  # per-verb client failures (recorder.rpc)
     "rpc_frame_errors.",  # server frame hygiene (core/rpc.py)
+    "train.comm_exposed_ms.",  # per-mesh-axis comm exposure (".data" ICI / ".slice" DCN)
 )
 
 BY_KIND = {

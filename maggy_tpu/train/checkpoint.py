@@ -271,6 +271,104 @@ class Checkpointer:
         self._manager.close()
 
 
+_DENSE_ZERO = {"stage": 0, "bucket_mb": None, "shards": 1}
+
+
+def restore_zero_compat(
+    checkpointer: Checkpointer,
+    state_template: Any,
+    *,
+    live_meta: Optional[Dict[str, Any]] = None,
+    step: Optional[int] = None,
+) -> Any:
+    """Restore a TrainState across ``zero_stage`` / bucket / data-width
+    transitions (docs/distributed.md "Gradient overlap & ZeRO").
+
+    Under ``zero_stage=1`` the optimizer state is saved as flat
+    data-sharded bucket vectors (parallel/overlap.py), a layout keyed by
+    the bucketing plan — which changes with ``bucket_mb`` and the data-axis
+    width. The sidecar meta records that layout (``checkpoint_meta()["zero"]``,
+    PR 9's provenance discipline); when it differs from the live trainer's,
+    this wrapper restores into a template of the SAVED layout, warns,
+    counts ``resilience.ckpt_zero_reshards``, and converts dense↔flat (or
+    flat↔flat across plans) before re-placing onto the live template's
+    shardings. With matching layouts it is exactly ``Checkpointer.restore``.
+    """
+    import jax
+    import numpy as np
+
+    from maggy_tpu import telemetry
+    from maggy_tpu.parallel import overlap
+
+    live_zero = dict((live_meta or {}).get("zero") or _DENSE_ZERO)
+    resolved = int(step) if step is not None else checkpointer.latest_step()
+    saved_meta = checkpointer.saved_meta(resolved) if resolved is not None else None
+    saved_zero = dict((saved_meta or {}).get("zero") or _DENSE_ZERO)
+    if saved_zero == live_zero:
+        return checkpointer.restore(
+            state_template, step=step, expect_meta=live_meta
+        )
+
+    params = state_template.params
+    abstract_params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+    )
+
+    def opt_template(zero: Dict[str, Any]):
+        if int(zero.get("stage") or 0) == 0:
+            abstract = jax.eval_shape(state_template.tx.init, abstract_params)
+            return None, abstract
+        plan = overlap.plan_buckets(
+            abstract_params,
+            zero.get("bucket_mb"),
+            pad_to=max(1, int(zero.get("shards") or 1)),
+        )
+        flats = {
+            b.name: jax.ShapeDtypeStruct((b.padded_size,), b.dtype)
+            for b in plan.buckets
+        }
+        return plan, jax.eval_shape(state_template.tx.init, flats)
+
+    saved_plan, saved_abstract = opt_template(saved_zero)
+    live_shardings = jax.tree.map(
+        lambda x: getattr(x, "sharding", None), state_template.opt_state
+    )
+    # concrete zeros (replicated) stand in for the saved layout: orbax
+    # overwrites every leaf, and the conversion below re-places the result
+    saved_opt = jax.tree.map(
+        lambda s: np.zeros(s.shape, s.dtype), saved_abstract
+    )
+    restored = checkpointer.restore(
+        state_template.replace(opt_state=saved_opt),
+        step=step,
+        expect_meta=live_meta,
+    )
+    telemetry.get().count("resilience.ckpt_zero_reshards")
+    warnings.warn(
+        f"checkpoint step {resolved} holds a {_zero_desc(saved_zero)} "
+        f"optimizer-state layout; converting to the live {_zero_desc(live_zero)} "
+        "layout during restore",
+        stacklevel=2,
+    )
+    opt = restored.opt_state
+    if saved_plan is not None:
+        opt = overlap.unflatten_opt_state(opt, saved_plan, params)
+    live_plan, _ = opt_template(live_zero)
+    if live_plan is not None:
+        opt = overlap.flatten_opt_state(opt, live_plan, params)
+    if all(s is not None for s in jax.tree.leaves(live_shardings)):
+        opt = jax.tree.map(jax.device_put, opt, live_shardings)
+    return restored.replace(opt_state=opt)
+
+
+def _zero_desc(zero: Dict[str, Any]) -> str:
+    if int(zero.get("stage") or 0) == 0:
+        return "dense (zero_stage=0)"
+    return (
+        f"ZeRO-1 (shards={zero.get('shards')}, bucket_mb={zero.get('bucket_mb')})"
+    )
+
+
 def load_finalized_trials(exp_dir: str) -> list:
     """Load every persisted trial.json under a previous experiment directory
     (the driver's persistence format, hpo.py _persist_trial). Goes through the

@@ -36,6 +36,31 @@ from maggy_tpu.models.transformer import (
 )
 
 
+# gradient-overlap seam (docs/distributed.md "Gradient overlap & ZeRO"):
+# pp-composed configs do NOT get per-stage bucketing yet — the 1F1B schedule
+# already interleaves its stage collectives, and re-bucketing inside the
+# stage shard_maps is future work. A zero_stage/bucket_mb request on a pp
+# mesh (or any other overlap-ineligible geometry) lands here: one explicit
+# process-wide warning, then the dense/pipeline path runs unchanged.
+_overlap_fallback_warned = False
+
+
+def warn_overlap_unbucketed(reason: str) -> None:
+    """Warn once per process that a requested gradient-overlap config falls
+    back to the unbucketed path; training proceeds unchanged."""
+    global _overlap_fallback_warned
+    if _overlap_fallback_warned:
+        return
+    _overlap_fallback_warned = True
+    import warnings
+
+    warnings.warn(
+        f"gradient overlap disabled: {reason}; training continues on the "
+        "unbucketed path",
+        stacklevel=3,
+    )
+
+
 def _pp_local_attention(q, k, v, *, causal: bool = True, segment_ids=None):
     """Attention inside the pipeline's shard_map must be device-local (the
     stage/data/fsdp axes are manual): the single-device Pallas flash kernel

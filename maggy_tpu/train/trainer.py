@@ -21,8 +21,10 @@ patching engine classes (SURVEY.md §7 design stance).
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import time
+import warnings
 from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -33,7 +35,16 @@ import optax
 from flax.training import train_state
 
 from maggy_tpu.parallel import sharding as shd
-from maggy_tpu.parallel.spec import AXIS_EXPERT, AXIS_SEQ, AXIS_STAGE, AXIS_TENSOR, ShardingSpec
+from maggy_tpu.parallel.spec import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_SLICE,
+    AXIS_STAGE,
+    AXIS_TENSOR,
+    ShardingSpec,
+)
 
 
 class TrainState(train_state.TrainState):
@@ -42,11 +53,14 @@ class TrainState(train_state.TrainState):
     update loop."""
 
 
-def lm_loss_fn(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
-    """Next-token cross entropy over ``batch["tokens"]`` with optional
-    ``batch["loss_mask"]``. With ``batch["segment_ids"]`` (packed sequences)
-    the boundary positions — where the target token belongs to a different
-    segment than its predictor — are masked out automatically."""
+def _lm_loss_parts(
+    logits: jax.Array, batch: Dict[str, jax.Array]
+) -> Tuple[jax.Array, jax.Array]:
+    """``(masked log-likelihood sum, mask weight)`` for the LM objective —
+    the sufficient statistics :func:`lm_loss_fn` normalizes. Split out so the
+    bucketed-overlap step can psum the two parts across batch shards and
+    reproduce the dense masked mean exactly (sum-of-sums / sum-of-weights),
+    instead of averaging per-shard means whose denominators differ."""
     tokens = batch["tokens"]
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
@@ -58,9 +72,18 @@ def lm_loss_fn(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
     if seg is not None:
         same = (seg[:, 1:] == seg[:, :-1]).astype(jnp.float32)
         mask = same if mask is None else mask * same
-    if mask is not None:
-        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    return -ll.mean()
+    if mask is None:
+        return ll.sum(), jnp.float32(ll.size)
+    return (ll * mask).sum(), mask.sum()
+
+
+def lm_loss_fn(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
+    """Next-token cross entropy over ``batch["tokens"]`` with optional
+    ``batch["loss_mask"]``. With ``batch["segment_ids"]`` (packed sequences)
+    the boundary positions — where the target token belongs to a different
+    segment than its predictor — are masked out automatically."""
+    ll_sum, weight = _lm_loss_parts(logits, batch)
+    return -ll_sum / jnp.maximum(weight, 1.0)
 
 
 def classification_loss_fn(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
@@ -117,9 +140,10 @@ class _FitAutopilotTarget:
     scope = "train"
     guard_metric = "steps_per_sec"
 
-    def __init__(self, prefetcher, metrics_window: int):
+    def __init__(self, prefetcher, metrics_window: int, trainer=None):
         self.prefetcher = prefetcher
         self.metrics_window = int(metrics_window)
+        self.trainer = trainer
 
     def sample(self):  # push-mode: the loop observes directly
         return {}
@@ -131,6 +155,13 @@ class _FitAutopilotTarget:
         cur = {"train.metrics_window": self.metrics_window}
         if self.prefetcher is not None:
             cur["train.prefetch_depth"] = self.prefetcher.depth
+        if self.trainer is not None:
+            # startup knobs: the planner proposes them for the NEXT run
+            # (memory-bound playbook raises zero_stage before shrinking
+            # batch); apply() rightly has no live handler for them
+            cur["train.zero_stage"] = int(self.trainer.zero_stage)
+            if self.trainer.bucket_mb is not None:
+                cur["train.bucket_mb"] = float(self.trainer.bucket_mb)
         return cur
 
     def apply(self, knob, value) -> bool:
@@ -162,6 +193,15 @@ class Trainer:
     # pending epoch (or a chaos slice_drop/slice_rejoin) interrupts the loop
     # with a membership exception the executor's reshape loop catches
     membership: Optional[Any] = None
+    # device-side comm/compute overlap (docs/distributed.md "Gradient
+    # overlap & ZeRO"): zero_stage=1 shards optimizer state over the data
+    # axis (each rank updates its shard, then all-gathers params);
+    # bucket_mb bounds the gradient-reduction bucket size in MiB so
+    # per-bucket collectives overlap the remaining backward. Defaults keep
+    # the dense step bit-for-bit. Only pure data/slice meshes are eligible —
+    # anything else warns once and stays dense (see _overlap_mode)
+    zero_stage: int = 0
+    bucket_mb: Optional[float] = None
 
     def __post_init__(self):
         self._train_step = None
@@ -173,6 +213,16 @@ class Trainer:
         # (shape key, shardings) memo so the per-step hot path never
         # recomputes the batch sharding tree — the spec plumbing runs once
         self._batch_shardings_memo = None
+        self._overlap_memo = None  # resolved (mode, manual axes, zero shards)
+        if self.zero_stage not in (0, 1):
+            raise ValueError(
+                f"Trainer.zero_stage must be 0 or 1, got {self.zero_stage!r}"
+            )
+        if self.bucket_mb is not None and not float(self.bucket_mb) > 0:
+            raise ValueError(
+                f"Trainer.bucket_mb must be positive (or None), got "
+                f"{self.bucket_mb!r}"
+            )
 
     # ---------------------------------------------------------------- pipeline
 
@@ -204,6 +254,268 @@ class Trainer:
             )
         return self._pp_parts
 
+    # ---------------------------------------------------------------- overlap
+
+    def _bucket_mb_eff(self) -> Optional[float]:
+        """bucket_mb normalized: None/inf (one bucket per dtype) -> None."""
+        if self.bucket_mb is None or not math.isfinite(float(self.bucket_mb)):
+            return None
+        return float(self.bucket_mb)
+
+    def _overlap_mode(self) -> Tuple[str, Tuple[str, ...], int]:
+        """Resolve (once per trainer) which step the config gets:
+        ``("off"|"bucket"|"zero", manual batch axes, zero shard count)``.
+
+        ``zero_stage``/``bucket_mb`` request the bucketed-overlap step
+        (parallel/overlap.py), which runs the model under a manual
+        shard_map over (slice, data). Ineligible configurations — pipeline
+        meshes, meshes with non-trivial GSPMD-auto axes (this XLA's SPMD
+        partitioner aborts on manual subgroups mixed with auto param
+        sharding; under fsdp the optimizer state is sharded by the rule
+        table already), or no batch axis to reduce over — warn once and
+        fall back to the dense path, so a knob sweep never hard-fails on
+        geometry."""
+        if self._overlap_memo is not None:
+            return self._overlap_memo
+        off = ("off", (), 1)
+        requested = self.zero_stage > 0 or self._bucket_mb_eff() is not None
+        if not requested:
+            self._overlap_memo = off
+            return off
+        from maggy_tpu.train.pipeline_adapter import warn_overlap_unbucketed
+
+        shape = dict(self.mesh.shape)
+        manual = tuple(
+            a for a in (AXIS_SLICE, AXIS_DATA) if shape.get(a, 1) > 1
+        )
+        blockers = sorted(
+            a
+            for a in (AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ, AXIS_EXPERT)
+            if shape.get(a, 1) > 1
+        )
+        mode = off
+        if self.pp > 1:
+            warn_overlap_unbucketed(
+                f"pipeline mesh (stage={self.pp}): per-stage bucketing is "
+                "not implemented, the 1F1B schedule keeps its own collectives"
+            )
+        elif blockers:
+            warn_overlap_unbucketed(
+                f"mesh axes {blockers} are GSPMD-auto; the overlap step "
+                "needs a pure data/slice mesh (fsdp already shards "
+                "optimizer state by the rule table)"
+            )
+        elif not manual:
+            warn_overlap_unbucketed("no data/slice mesh axis > 1 to reduce over")
+        else:
+            dz = shape.get(AXIS_DATA, 1) if self.zero_stage > 0 else 1
+            if self.zero_stage > 0 and dz == 1:
+                warnings.warn(
+                    "zero_stage=1 needs a data-axis extent > 1; optimizer "
+                    "states stay replicated (effective zero_stage=0)",
+                    stacklevel=3,
+                )
+                dz = 1
+            mode = ("zero" if dz > 1 else "bucket", manual, dz)
+        self._overlap_memo = mode
+        return mode
+
+    def _build_overlap_train_step(
+        self, mode: str, manual: Tuple[str, ...], dz: int,
+        comm_axes: Optional[Tuple[str, ...]] = None, donate: bool = True,
+    ):
+        """The bucketed-collective train step (docs/distributed.md "Gradient
+        overlap & ZeRO").
+
+        The whole step runs under a *manual* shard_map over the batch axes,
+        so the gradient reduction is spelled per bucket, per mesh axis —
+        intra-slice ``data`` (ICI) first, cross-slice ``slice`` (DCN)
+        second — in reverse-topological bucket order. Each bucket's
+        collective depends only on its own grads, which is what lets XLA's
+        latency-hiding scheduler start it while the rest of backward runs
+        (``overlap.latency_hiding_flags`` on real TPU backends). Under
+        ``mode="zero"`` the data-axis reduction is a reduce-scatter, the
+        optimizer update touches only the local shard (the optax state IS
+        the flat shard layout — see ``_init_fn``), and an all-gather
+        rebuilds the params; optimizer memory per device drops ~1/dz.
+
+        ``comm_axes`` (bench comm-probe only, bucket mode) restricts which
+        axes actually reduce — () strips every collective to time pure
+        compute; the resulting numerics are wrong on purpose.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from maggy_tpu import telemetry
+        from maggy_tpu.parallel import overlap
+        from maggy_tpu.util import shard_map as _shard_map
+
+        axes_comm = tuple(manual if comm_axes is None else comm_axes)
+        assert all(a in manual for a in axes_comm)
+        assert mode in ("bucket", "zero") and (mode != "zero" or dz > 1)
+        if mode == "zero" and comm_axes is not None:
+            raise ValueError("comm-probe variants are bucket-mode only")
+        mesh_shape = dict(self.mesh.shape)
+        n_manual = 1
+        for a in manual:
+            n_manual *= mesh_shape[a]
+        is_lm = self.loss_fn is lm_loss_fn
+        bucket_mb = self._bucket_mb_eff()
+        tel = telemetry.get()
+
+        def local_objective(params, batch):
+            # per-device objective chosen so psum over the manual axes
+            # reproduces the dense objective exactly: LM losses contribute
+            # sum/weight parts (global masked mean), generic losses the
+            # mean-of-shards (exact for uniform means), aux terms the
+            # mean-of-shards (router losses are per-token means)
+            logits, mods = self.model.apply(
+                {"params": params}, *_model_inputs(batch),
+                mutable=["intermediates"],
+            )
+            aux_dev = collect_aux_losses(mods) / n_manual
+            if is_lm:
+                ll_sum, weight = _lm_loss_parts(logits, batch)
+                w_global = jax.lax.psum(weight, manual)
+                data_dev = -ll_sum / jnp.maximum(w_global, 1.0)
+            else:
+                data_dev = self.loss_fn(logits, batch) / n_manual
+            return data_dev + aux_dev, (data_dev, aux_dev)
+
+        def reduce_bucket(vec, scatter: bool):
+            # ICI before DCN: the fast intra-slice hop issues first so the
+            # slow cross-slice all-reduce overlaps it (and later buckets'
+            # backward) independently
+            if AXIS_DATA in axes_comm:
+                if scatter:
+                    vec = jax.lax.psum_scatter(vec, AXIS_DATA, tiled=True)
+                elif AXIS_DATA in manual:
+                    vec = jax.lax.psum(vec, AXIS_DATA)
+            if AXIS_SLICE in axes_comm:
+                vec = jax.lax.psum(vec, AXIS_SLICE)
+            return vec
+
+        def train_step(state: TrainState, batch):
+            # plan from traced shapes: static at trace time, rebuilt free on
+            # recompile, never stored host-side
+            plan = overlap.plan_buckets(state.params, bucket_mb, pad_to=dz)
+            tel.gauge("train.bucket_count", len(plan.buckets))
+
+            def body_bucket(params, batch):
+                (_, (data_dev, aux_dev)), grads = jax.value_and_grad(
+                    local_objective, has_aux=True
+                )(params, batch)
+                flats = overlap.flatten_buckets(grads, plan)
+                flats = {
+                    name: reduce_bucket(vec, scatter=False)
+                    for name, vec in flats.items()
+                }
+                grads = overlap.unflatten_buckets(flats, plan, grads)
+                loss = jax.lax.psum(data_dev, manual)
+                aux = jax.lax.psum(aux_dev, manual)
+                return grads, (loss, aux)
+
+            def body_zero(params, opt_state, batch):
+                (_, (data_dev, aux_dev)), grads = jax.value_and_grad(
+                    local_objective, has_aux=True
+                )(params, batch)
+                gflats = overlap.flatten_buckets(grads, plan)
+                gshards = {
+                    name: reduce_bucket(vec, scatter=True)
+                    for name, vec in gflats.items()
+                }
+                # each rank owns one 1/dz shard of every flat bucket; the
+                # optimizer update below runs on shards only
+                idx = jax.lax.axis_index(AXIS_DATA)
+                pflats = overlap.flatten_buckets(params, plan)
+                pshards = {
+                    name: jax.lax.dynamic_slice_in_dim(
+                        vec, idx * (vec.shape[0] // dz), vec.shape[0] // dz
+                    )
+                    for name, vec in pflats.items()
+                }
+                updates, new_opt = self.optimizer.update(
+                    gshards, opt_state, pshards
+                )
+                new_shards = optax.apply_updates(pshards, updates)
+                new_flats = {
+                    name: jax.lax.all_gather(v, AXIS_DATA, tiled=True)
+                    for name, v in new_shards.items()
+                }
+                new_params = overlap.unflatten_buckets(new_flats, plan, params)
+                # shards partition the full (slice-reduced) gradient over
+                # data, so the global sq-norm is the data-psum of local ones
+                gsq = sum(
+                    jnp.sum(jnp.square(v.astype(jnp.float32)))
+                    for v in gshards.values()
+                )
+                gnorm = jnp.sqrt(jax.lax.psum(gsq, AXIS_DATA))
+                loss = jax.lax.psum(data_dev, manual)
+                aux = jax.lax.psum(aux_dev, manual)
+                return new_params, new_opt, (loss, aux, gnorm)
+
+            batch_spec = P(manual)
+            if mode == "zero":
+                padded = plan.padded_sizes
+                opt_spec = jax.tree.map(
+                    lambda l: P(AXIS_DATA)
+                    if getattr(l, "ndim", 0) == 1 and l.shape[0] in padded
+                    else P(),
+                    state.opt_state,
+                )
+                fn = _shard_map(
+                    body_zero,
+                    mesh=self.mesh,
+                    in_specs=(P(), opt_spec, batch_spec),
+                    out_specs=(P(), opt_spec, P()),
+                    check_vma=False,
+                    axis_names=frozenset(manual),
+                )
+                new_params, new_opt, (loss, aux, gnorm) = fn(
+                    state.params, state.opt_state, batch
+                )
+                new_state = state.replace(
+                    step=state.step + 1, params=new_params, opt_state=new_opt
+                )
+            else:
+                fn = _shard_map(
+                    body_bucket,
+                    mesh=self.mesh,
+                    in_specs=(P(), batch_spec),
+                    out_specs=(P(), P()),
+                    check_vma=False,
+                    axis_names=frozenset(manual),
+                )
+                grads, (loss, aux) = fn(state.params, batch)
+                gnorm = optax.global_norm(grads)
+                new_state = state.apply_gradients(grads=grads)
+            return new_state, {
+                "loss": loss,
+                "aux_loss": aux,
+                "total_loss": loss + aux,
+                "grad_norm": gnorm,
+                "step": state.step,
+            }
+
+        return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+    def overlap_step_variant(
+        self, comm_axes: Optional[Tuple[str, ...]] = None, donate: bool = True
+    ):
+        """A compiled bucketed step reducing only over ``comm_axes`` — the
+        bench's comm-probe (``()`` strips all collectives to time pure
+        compute). Timing-only: skipped reductions make the numerics wrong
+        on purpose. Requires an eligible bucket-mode (zero_stage=0)
+        trainer."""
+        mode, manual, dz = self._overlap_mode()
+        if mode != "bucket":
+            raise ValueError(
+                "overlap_step_variant needs an overlap-eligible "
+                f"zero_stage=0 trainer (resolved mode: {mode!r})"
+            )
+        return self._build_overlap_train_step(
+            mode, manual, dz, comm_axes=comm_axes, donate=donate
+        )
+
     # ------------------------------------------------------------------ state
 
     def _init_fn(self) -> Callable:
@@ -215,6 +527,27 @@ class Trainer:
                 stage_params = parts.restack(shd.unbox(variables["params"]))
                 return TrainState.create(
                     apply_fn=self.model.apply, params=stage_params, tx=self.optimizer
+                )
+        elif self._overlap_mode()[0] == "zero":
+            bucket_mb = self._bucket_mb_eff()
+            dz = self._overlap_mode()[2]
+
+            def init_fn(rng, *ins):
+                from maggy_tpu.parallel import overlap
+
+                variables = self.model.init(rng, *ins)
+                st = TrainState.create(
+                    apply_fn=self.model.apply, params=variables["params"],
+                    tx=self.optimizer,
+                )
+                # ZeRO-1: the optax state mirrors the FLAT bucket vectors
+                # (the layout the sharded update consumes), not the param
+                # tree — state_shardings_for places them P(data)
+                plan = overlap.plan_buckets(st.params, bucket_mb, pad_to=dz)
+                return st.replace(
+                    opt_state=self.optimizer.init(
+                        overlap.flatten_buckets(st.params, plan)
+                    )
                 )
         else:
             def init_fn(rng, *ins):
@@ -309,6 +642,29 @@ class Trainer:
             self.state_shardings = shd.params_shardings(
                 self.mesh, abstract, self.rules
             )
+            mode, _, dz = self._overlap_mode()
+            if mode == "zero":
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                from maggy_tpu.parallel import overlap
+
+                # the flat ZeRO bucket vectors (built by _init_fn) live
+                # sharded over data; loose leaves (adam count) replicate
+                plan = overlap.plan_buckets(
+                    abstract.params, self._bucket_mb_eff(), pad_to=dz
+                )
+                padded = plan.padded_sizes
+                self.state_shardings = self.state_shardings.replace(
+                    opt_state=jax.tree.map(
+                        lambda leaf, cur: NamedSharding(self.mesh, P(AXIS_DATA))
+                        if getattr(leaf, "ndim", 0) == 1
+                        and leaf.shape[0] in padded
+                        else cur,
+                        abstract.opt_state,
+                        self.state_shardings.opt_state,
+                    )
+                )
         return self.state_shardings
 
     def make_state(self, rng: jax.Array, sample_batch: Dict[str, Any]) -> TrainState:
@@ -586,7 +942,11 @@ class Trainer:
 
     def _build_train_step(self):
         if self.pp > 1:
+            self._overlap_mode()  # zero/bucket on a pp mesh: one-time warning
             return self._build_pp_train_step()
+        mode, manual, dz = self._overlap_mode()
+        if mode != "off":
+            return self._build_overlap_train_step(mode, manual, dz)
 
         def train_step(state: TrainState, batch):
             def loss_of(params):
@@ -622,6 +982,7 @@ class Trainer:
         compare it against the live trainer's and warn on mismatch."""
         mesh_axes = {k: v for k, v in dict(self.mesh.shape).items() if v > 1}
         cfg = getattr(self.model, "cfg", None)
+        mode, _, dz = self._overlap_mode()
         return {
             "mesh_axes": mesh_axes,
             "num_devices": int(self.mesh.size),
@@ -632,6 +993,15 @@ class Trainer:
             "n_processes": int(jax.process_count()),
             "n_microbatches": self.n_microbatches,
             "dtype": str(getattr(cfg, "dtype", None)) if cfg is not None else None,
+            # EFFECTIVE ZeRO layout (not the requested knobs): what
+            # restore_zero_compat needs to rebuild the saved optimizer-state
+            # layout when zero_stage / bucket_mb / data width change between
+            # save and restore
+            "zero": {
+                "stage": 1 if mode == "zero" else 0,
+                "bucket_mb": self._bucket_mb_eff() if mode == "zero" else None,
+                "shards": dz,
+            },
         }
 
     def _membership_check(self, state, step: int, checkpointer, chaos, tel) -> None:
@@ -912,11 +1282,18 @@ class Trainer:
                 checkpointer.latest_step() if resume == "auto" else int(resume)
             )
             if target is not None and target > int(state.step):
+                from maggy_tpu.train.checkpoint import restore_zero_compat
+
                 start = int(state.step)
-                state = checkpointer.restore(
+                # zero-layout-aware restore: a checkpoint written under a
+                # different zero_stage/bucket/data-width gets its optimizer
+                # state converted (warn-and-reshard) instead of failing on
+                # the flat-vs-dense tree mismatch
+                state = restore_zero_compat(
+                    checkpointer,
                     state,
                     step=None if resume == "auto" else target,
-                    expect_meta=self.checkpoint_meta(),
+                    live_meta=self.checkpoint_meta(),
                 )
                 resumed_from = int(state.step)
                 skipped = resumed_from - start
@@ -972,7 +1349,7 @@ class Trainer:
             ap_cfg = (
                 autopilot if isinstance(autopilot, _ApConfig) else _ApConfig()
             )
-            ap_target = _FitAutopilotTarget(prefetcher, window)
+            ap_target = _FitAutopilotTarget(prefetcher, window, trainer=self)
         ap_wait_total = prefetcher.wait_ms_total if prefetcher is not None else 0.0
         pending: deque = deque()  # (loop index, in-flight device metrics)
         ready = None  # newest entry aged OUT of the window: safe to sync
@@ -1274,7 +1651,15 @@ class TrainContext:
         optimizer,
         loss_fn: Callable = lm_loss_fn,
         n_microbatches: Optional[int] = None,
+        zero_stage: Optional[int] = None,
+        bucket_mb: Optional[float] = None,
     ) -> Trainer:
+        # overlap knobs default to the spec's (config/distributed.py plumbs
+        # them there); explicit arguments win
+        if zero_stage is None:
+            zero_stage = getattr(self.spec, "zero_stage", 0)
+        if bucket_mb is None:
+            bucket_mb = getattr(self.spec, "bucket_mb", None)
         return Trainer(
             model,
             optimizer,
@@ -1283,6 +1668,8 @@ class TrainContext:
             rules=self.rules,
             n_microbatches=n_microbatches,
             membership=self.membership,
+            zero_stage=int(zero_stage),
+            bucket_mb=bucket_mb,
         )
 
     def shard(self, tree, logical_axes=("batch",)):
