@@ -715,6 +715,59 @@ def bench_trace_overhead(quick: bool = False):
     }
 
 
+def bench_timeseries(quick: bool = False):
+    """extra.timeseries: sampler + alert-evaluator overhead gate (ISSUE 13).
+
+    The worker's metrics tick (``SeriesStore.sample`` over a recorder
+    populated like a busy serving process, plus ``AlertEvaluator.evaluate``
+    and ``RecompileSentinel.observe``) runs once per ``interval_s`` (1 s)
+    regardless of the step rate, so its wall-clock share IS tick cost /
+    tick interval — a deterministic model with no A/B noise, same rationale
+    as extra.trace_overhead. Budget: <= 2% of step/decode time, i.e. the
+    tick must cost <= 20 ms of every second."""
+    import time as _time
+
+    from maggy_tpu.telemetry.alerts import AlertEvaluator, RecompileSentinel
+    from maggy_tpu.telemetry.recorder import Telemetry
+    from maggy_tpu.telemetry.timeseries import SeriesStore
+
+    tel = Telemetry(worker="bench-timeseries")
+    # populate like a busy serving worker: ~30 gauges, 10 counters, 4 hists
+    for i in range(30):
+        tel.gauge(f"serve.g{i}", float(i))
+    for i in range(10):
+        tel.count(f"serve.c{i}", i)
+    for name in ("serve.ttft_ms", "serve.tpot_ms", "serve.e2e_ms", "serve.queue_ms"):
+        for ms in (3.0, 8.0, 21.0, 55.0, 144.0):
+            tel.histogram(name, ms)
+
+    store = SeriesStore()
+    alerts = AlertEvaluator(store, tel, scope="worker")
+    sentinel = RecompileSentinel(store, tel, steady=("decode",))
+    compile_counts = {"decode": 1, "prefill": 3, "admit": 1}
+
+    n = 200 if quick else 600
+    base = 1_000_000.0
+    # warm allocation paths (first tick creates every Series object)
+    store.sample(tel, base)
+    t0 = _time.perf_counter()
+    for tick in range(n):
+        now = base + 1.0 + tick  # 1 Hz, matching the scheduler's flush cadence
+        store.sample(tel, now)
+        sentinel.observe(compile_counts, now)
+        alerts.evaluate(now)
+    tick_us = (_time.perf_counter() - t0) / n * 1e6
+    # one tick per interval_s of wall clock -> share of step/decode time
+    overhead_pct = tick_us / (store.interval_s * 1e6) * 100
+    return {
+        "tick_us": round(tick_us, 1),
+        "series_tracked": len(store.names()),
+        "interval_s": store.interval_s,
+        "overhead_pct": round(overhead_pct, 3),
+        "within_budget": overhead_pct <= 2.0,
+    }
+
+
 def bench_fleet(quick: bool = False):
     """Serving fleet (maggy_tpu/serve/fleet, ISSUE 6): aggregate tok/s and
     TTFT p50/p95 at a FIXED offered load through the router with N=1 vs N=2
@@ -1180,6 +1233,54 @@ def bench_asha_trials_per_hour(quick: bool = False):
         env_mod.set_instance(None)
 
 
+def write_run_summary(out) -> str:
+    """Persist one compact BENCH_<n>.json per run: headline tok/s, serving
+    TTFT p50/p95, training steps/sec, and every gate bit the extras carry.
+    n is the next free integer — driver-written BENCH_r01.json-style records
+    use a letter prefix and are never scanned or clobbered."""
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    taken = [
+        int(m.group(1))
+        for f in os.listdir(here)
+        if (m := re.fullmatch(r"BENCH_(\d+)\.json", f))
+    ]
+    n = max(taken, default=0) + 1
+    extra = out.get("extra", {})
+
+    def _get(block, key):
+        v = extra.get(block)
+        return v.get(key) if isinstance(v, dict) else None
+
+    step_ms = extra.get("step_ms")
+    gates = {}
+    for block, key in (
+        ("trace_overhead", "within_budget"),
+        ("timeseries", "within_budget"),
+        ("paging", "within_budget"),
+        ("overlap", "within_budget"),
+    ):
+        bit = _get(block, key)
+        if bit is not None:
+            gates[block] = bool(bit)
+    summary = {
+        "n": n,
+        "time": time.time(),
+        "tok_per_sec_per_chip": out.get("value"),
+        "serve_tok_per_sec": _get("serving", "tok_per_sec"),
+        "ttft_ms_p50": _get("serving", "ttft_ms_p50"),
+        "ttft_ms_p95": _get("serving", "ttft_ms_p95"),
+        "steps_per_sec": round(1000.0 / step_ms, 3) if step_ms else None,
+        "gates": gates,
+        "cpu_fallback": extra.get("cpu_fallback"),
+    }
+    path = os.path.join(here, f"BENCH_{n}.json")
+    with open(path, "w") as f:
+        json.dump(summary, f, indent=2)
+    return path
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
@@ -1206,6 +1307,7 @@ def main():
         elastic_stats = None
         paging_stats = None
         overlap_stats = None
+        timeseries_stats = None
     else:
         asha_stats = bench_asha_trials_per_hour(quick=args.quick)
         try:
@@ -1252,6 +1354,10 @@ def main():
             overlap_stats = bench_overlap(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
             overlap_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            timeseries_stats = bench_timeseries(quick=args.quick)
+        except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
+            timeseries_stats = {"error": f"{type(e).__name__}: {e}"}
 
     def rnd(v, digits):
         return None if v is None else round(v, digits)
@@ -1283,6 +1389,7 @@ def main():
             "elastic": elastic_stats,
             "paging": paging_stats,
             "overlap": overlap_stats,
+            "timeseries": timeseries_stats,
             "tuned": tuned or None,
         },
     }
@@ -1314,6 +1421,10 @@ def main():
                 out["extra"]["last_real_tpu"] = json.load(f)
         except (OSError, ValueError):
             pass
+    try:
+        write_run_summary(out)
+    except OSError:
+        pass
     print(json.dumps(out))
 
 

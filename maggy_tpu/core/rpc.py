@@ -342,6 +342,23 @@ class Server:
     def register_callback(self, verb: str, handler) -> None:
         self.callbacks[verb] = handler
 
+    def register_metrics(self, source) -> None:
+        """Expose a time-series store (or stores) under the ``METRICS`` verb.
+
+        ``source`` is a zero-arg callable returning the reply body — usually
+        a closure over ``SeriesStore.snapshot()`` — or a store itself. The
+        reply is ``{"type": "METRICS", ...body}``; handlers run on the event
+        loop, and ``snapshot()`` only copies bounded rings, so this is safe
+        to serve while the owner keeps sampling."""
+
+        def _on_metrics(_msg: Dict[str, Any]) -> Dict[str, Any]:
+            body = source() if callable(source) else source.snapshot()
+            out = {"type": "METRICS"}
+            out.update(body or {})
+            return out
+
+        self.register_callback("METRICS", _on_metrics)
+
     def enqueue(self, msg: Dict[str, Any]) -> None:
         self.message_queue.put(msg)
 

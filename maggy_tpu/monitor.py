@@ -292,6 +292,60 @@ def _autopilot_line(sv: dict) -> list:
     return [" ".join(parts)]
 
 
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values: list, width: int = 16) -> str:
+    """Unicode sparkline over the last ``width`` values, scaled to the
+    window's own min/max (a trend display, not an absolute scale)."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK_BLOCKS[0] * len(vals)
+    span = hi - lo
+    return "".join(
+        _SPARK_BLOCKS[min(7, int((v - lo) / span * 8))] for v in vals
+    )
+
+
+def _trend_lines(sv: dict, width: int) -> list:
+    """Sparkline trend block from the router's fleet time-series store
+    (``trends`` in SSTATS — docs/observability.md "Time series")."""
+    trends = sv.get("trends") or {}
+    lines = []
+    for name in sorted(trends):
+        vals = trends[name]
+        if not vals:
+            continue
+        short = name.split(".", 1)[-1]
+        latest = vals[-1]
+        shown = f"{latest:,.1f}" if isinstance(latest, float) else str(latest)
+        lines.append(f"  ~ {short:<18} {_spark(vals)}  {shown}"[:width])
+    return lines
+
+
+def _alert_lines(sv: dict, width: int) -> list:
+    """ALERTS line from the firing set the scheduler/router folds into
+    SSTATS (``telemetry/alerts.py``); silent when nothing is firing."""
+    alerts = sv.get("alerts") or []
+    if not alerts:
+        return []
+    parts = []
+    for a in alerts:
+        tag = str(a.get("alert", "?"))
+        tag = tag[len("alert."):] if tag.startswith("alert.") else tag
+        if a.get("program"):
+            tag += f":{a['program']}"
+        if a.get("severity") == "critical":
+            tag += "(!)"
+        if a.get("replica") is not None:
+            tag += f"@r{a['replica']}"
+        parts.append(tag)
+    return _wrap_parts([f"ALERTS[{len(alerts)}]:"] + parts, width)
+
+
 def _wrap_parts(parts: list, width: int) -> list:
     """Flow ``parts`` onto as many panel lines as needed, breaking only at
     part boundaries — the latency summary outgrew one line, and truncating
@@ -394,6 +448,8 @@ def render_status(status: dict, width: int = 78) -> str:
         agg.extend(_latency_parts(sv))
         lines.extend(_wrap_parts(agg, width))
         lines.extend(line[:width] for line in _autopilot_line(sv))
+        lines.extend(_alert_lines(sv, width))
+        lines.extend(_trend_lines(sv, width))
         for row in fleet.get("replicas") or []:
             bar = util.progress_bar(
                 row.get("active_slots", 0), max(row.get("num_slots", 1), 1),
@@ -442,6 +498,7 @@ def render_status(status: dict, width: int = 78) -> str:
             parts.append(f"decode compiles {compiles}")
         lines.extend(_wrap_parts(parts, width))
         lines.extend(line[:width] for line in _autopilot_line(sv))
+        lines.extend(_alert_lines(sv, width))
         lines.extend(_telemetry_lines(status, width))
     elif status.get("workers_done") is not None:
         lines.append(

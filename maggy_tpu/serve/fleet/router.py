@@ -66,8 +66,17 @@ from maggy_tpu.serve.fleet.prefill import (
 )
 from maggy_tpu.serve.fleet.replica import DEAD, UP, Replica
 from maggy_tpu.serve.scheduler import LATENCY_SIGNALS
-from maggy_tpu.telemetry import tracing
+from maggy_tpu.telemetry import timeseries, tracing
+from maggy_tpu.telemetry.alerts import AlertEvaluator
 from maggy_tpu.telemetry.histogram import merge_dicts
+
+# fleet series surfaced as sparkline trends on the monitor panel
+TREND_SIGNALS = (
+    "serve.queue_depth",
+    "serve.tokens_per_sec",
+    "serve.ttft_ms",
+    "fleet.healthy_replicas",
+)
 
 # router-side request states (downstream states pass through verbatim)
 PENDING = "pending"  # accepted, not yet on a replica
@@ -252,6 +261,16 @@ class Router:
         self._stop = threading.Event()
         self._pump: Optional[threading.Thread] = None
         self._started_ts = time.time()
+        # fleet observability (docs/observability.md "Time series"): one
+        # store per replica fed from the SSTATS probe cache, plus a
+        # fleet-aggregate store fed at the *same* tick with the bucket-wise
+        # merge — the alignment that lets tools/metrics_query.py reproduce
+        # fleet windowed percentiles from per-replica snapshots. Alert
+        # rules run at fleet scope over the aggregate store.
+        self.metrics = timeseries.SeriesStore()
+        self.replica_metrics: Dict[int, timeseries.SeriesStore] = {}
+        self.alerts = AlertEvaluator(self.metrics, self.telemetry, scope="fleet")
+        self._last_metrics_tick = 0.0
         for verb, handler in (
             ("SUBMIT", self._on_submit),
             ("POLL", self._on_poll),
@@ -261,6 +280,7 @@ class Router:
             ("LOG", self._on_log),
         ):
             self._rpc.register_callback(verb, handler)
+        self._rpc.register_metrics(self._metrics_body)
 
     @property
     def secret(self) -> str:
@@ -576,6 +596,15 @@ class Router:
             )
         if self.autopilot is not None:
             agg["autopilot"] = self.autopilot.status()
+        # ALERTS surface: fleet-scope rules plus whatever each replica's
+        # worker-scope evaluator reports in its SSTATS
+        alerts = list(self.alerts.firing())
+        for r in self.replicas:
+            stats = self._stats_cache.get(r.index) or {}
+            for a in stats.get("alerts") or []:
+                alerts.append(dict(a, replica=r.index))
+        agg["alerts"] = alerts
+        agg["trends"] = self.metrics.trends(TREND_SIGNALS)
         return {
             **agg,
             "replicas": table,
@@ -589,6 +618,100 @@ class Router:
     def _on_stats(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
             return {"type": "SSTATS", "fleet": True, **self._fleet_stats()}
+
+    def _metrics_body(self) -> Dict[str, Any]:
+        """METRICS verb: aligned per-replica + fleet-aggregate series."""
+        with self._lock:
+            replicas = {
+                str(idx): store.snapshot()
+                for idx, store in self.replica_metrics.items()
+            }
+        return {
+            "scope": "fleet",
+            "metrics": self.metrics.snapshot(),
+            "replicas": replicas,
+            "alerts": self.alerts.firing(),
+        }
+
+    def _sample_metrics(self, now: float) -> None:
+        """One aligned fleet observability tick (pump thread, ~1 Hz).
+
+        Appends each replica's cached cumulative stats to its per-replica
+        store AND the bucket-wise merge of the same snapshots to the fleet
+        store at the same timestamp, then evaluates the fleet-scope alert
+        rules. Using one ``now`` for every append is what makes windowed
+        fleet queries equal the merge of windowed per-replica queries."""
+        if now - self._last_metrics_tick < self.metrics.interval_s:
+            return
+        self._last_metrics_tick = now
+        with self._lock:
+            cache = {
+                r.index: self._stats_cache.get(r.index)
+                for r in self.replicas
+            }
+            pending = len(self._pending)
+        latency_all: Dict[str, List[Dict[str, Any]]] = {}
+        slo_ok_sum = 0
+        slo_miss_sum = 0
+        have_replica_slo = False
+        fleet_gauges = {
+            "serve.queue_depth": float(pending),
+            "fleet.healthy_replicas": float(len(self._healthy())),
+        }
+        tokens_per_sec = 0.0
+        for idx, stats in cache.items():
+            if not stats:
+                continue
+            store = self.replica_metrics.get(idx)
+            if store is None:
+                store = timeseries.SeriesStore(self.metrics.interval_s)
+                with self._lock:
+                    self.replica_metrics[idx] = store
+            hists = {
+                f"serve.{name}": d
+                for name, d in (stats.get("latency") or {}).items()
+            }
+            counters = {"serve.requests_done": stats.get("requests_done", 0)}
+            if stats.get("slo_ok") is not None:
+                have_replica_slo = True
+                slo_ok_sum += int(stats.get("slo_ok") or 0)
+                slo_miss_sum += int(stats.get("slo_miss") or 0)
+                counters["serve.slo_ok"] = stats.get("slo_ok")
+                counters["serve.slo_miss"] = stats.get("slo_miss")
+            store.ingest(
+                now,
+                gauges={
+                    "serve.queue_depth": stats.get("queue_depth"),
+                    "serve.active_slots": stats.get("active_slots"),
+                    "serve.tokens_per_sec": stats.get("tokens_per_sec"),
+                    "serve.ttft_ms": stats.get("ttft_ms_p95"),
+                    "serve.pages_free": (stats.get("paging") or {}).get("pages_free"),
+                },
+                counters=counters,
+                hists=hists,
+            )
+            tokens_per_sec += float(stats.get("tokens_per_sec") or 0.0)
+            for name, d in (stats.get("latency") or {}).items():
+                latency_all.setdefault(name, []).append(d)
+        fleet_gauges["serve.tokens_per_sec"] = round(tokens_per_sec, 2)
+        merged_hists: Dict[str, Dict[str, Any]] = {}
+        for name, ds in latency_all.items():
+            h = merge_dicts(ds)
+            if h is not None:
+                merged_hists[f"serve.{name}"] = h.to_dict()
+        if merged_hists.get("serve.ttft_ms"):
+            p95 = timeseries.hist_delta(merged_hists["serve.ttft_ms"], None)
+            fleet_gauges["serve.ttft_ms"] = p95.percentile(0.95) if p95 else None
+        # exact fleet-edge SLO counters when the router judges TTFT itself;
+        # the sum of replica-side counters stands in otherwise
+        counters = {}
+        if self.config.slo_ttft_ms is not None:
+            counters = {"serve.slo_ok": self.slo_ok, "serve.slo_miss": self.slo_miss}
+        elif have_replica_slo:
+            counters = {"serve.slo_ok": slo_ok_sum, "serve.slo_miss": slo_miss_sum}
+        self.metrics.ingest(now, gauges=fleet_gauges, counters=counters, hists=merged_hists)
+        self.alerts.evaluate(now)
+        self.telemetry.gauge("alerts.firing", float(len(self.alerts.firing())))
 
     def _on_status(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
@@ -650,6 +773,7 @@ class Router:
             try:
                 if now - last_probe >= self.config.probe_interval_s:
                     self._probe_replicas()
+                    self._sample_metrics(now)
                     self._retire_old(now)
                     last_probe = now
                 self._chaos_tick()

@@ -39,7 +39,8 @@ from maggy_tpu.serve import request as rq
 from maggy_tpu.serve.engine import Engine
 from maggy_tpu.serve.paging import OutOfPagesError
 from maggy_tpu.serve.request import Request, SamplingParams
-from maggy_tpu.telemetry import flightrec, tracing
+from maggy_tpu.telemetry import flightrec, timeseries, tracing
+from maggy_tpu.telemetry.alerts import AlertEvaluator, RecompileSentinel
 from maggy_tpu.telemetry.histogram import LatencyHistogram
 
 # the latency signals the scheduler aggregates (histogram per signal);
@@ -119,6 +120,16 @@ class Scheduler:
             "failed": 0,
             "rejected": 0,
         }
+        # observability tick state (docs/observability.md "Time series"):
+        # the loop samples the recorder into bounded ring-buffer series on
+        # the ~1 s flush cadence, evaluates the checked-in alert rules at
+        # worker scope, and the sentinel watches engine compile counts for
+        # retraces outside a reconfigure window
+        self.metrics = timeseries.SeriesStore()
+        self.alerts = AlertEvaluator(self.metrics, self.telemetry, scope="worker")
+        self.sentinel = RecompileSentinel(
+            self.metrics, self.telemetry, scope="worker", steady=("decode", "admit")
+        )
 
     # ------------------------------------------------------------- public API
     # (called from RPC handler threads; must not block on device work)
@@ -244,6 +255,9 @@ class Scheduler:
         if target is None or self.engine.slots.active_count:
             return
         try:
+            # a reconfigure legitimately recompiles decode/admit: tell the
+            # sentinel so the count bump re-baselines instead of alerting
+            self.sentinel.expect()
             self.engine.reconfigure(target)
         except Exception as e:  # noqa: BLE001 - a failed re-tune must not kill serving
             self.telemetry.event(
@@ -251,6 +265,25 @@ class Scheduler:
                 num_slots=target, error=f"{type(e).__name__}: {e}",
             )
         self._pending_slots = None
+
+    def _metrics_tick(self, now: float, wd=None) -> None:
+        """One observability tick (loop thread, ~1 Hz with the flush):
+        sample the recorder into the series rings, ingest the SLO counters,
+        feed compile counts to the sentinel, run the alert rules."""
+        self.metrics.sample(self.telemetry, now)
+        if self.slo_ttft_ms is not None:
+            self.metrics.ingest(
+                now,
+                counters={
+                    "serve.slo_ok": self.slo_ok,
+                    "serve.slo_miss": self.slo_miss,
+                },
+            )
+        self.sentinel.observe(self.engine.compile_counts, now, watchdog=wd)
+        self.alerts.evaluate(now, watchdog=wd)
+        self.telemetry.gauge(
+            "alerts.firing", len(self.alerts.firing()) + len(self.sentinel.firing())
+        )
 
     def stats(self) -> Dict[str, Any]:
         """One consistent snapshot, built entirely under the scheduler lock.
@@ -304,6 +337,7 @@ class Scheduler:
             snap["slo_miss"] = miss
             snap["slo_attainment"] = ok / (ok + miss) if (ok + miss) else None
         snap.update({f"requests_{k}": v for k, v in counters.items()})
+        snap["alerts"] = self.alerts.firing() + self.sentinel.firing()
         if self.autopilot is not None:
             snap["autopilot"] = self.autopilot.status()
         return snap
@@ -599,6 +633,7 @@ class Scheduler:
             tel.gauge("serve.active_slots", self.engine.slots.active_count)
             if time.time() - last_flush > 1.0:
                 self._retire_old(time.time())
+                self._metrics_tick(time.time(), wd)
                 tel.flush()
                 last_flush = time.time()
         tel.flush()

@@ -10,6 +10,9 @@ length-framed JSON over TCP, secret-authenticated) with a serving verb set:
 * ``CANCEL``  — ``{id}`` -> ``{cancelled: bool}``
 * ``SSTATS``  — scheduler/engine stats (queue depth, slot occupancy,
   tokens/sec, TTFT percentiles, compile counts)
+* ``METRICS`` — the scheduler's time-series store as a versioned snapshot
+  (``telemetry/timeseries.py``), for the router's fleet merge and
+  ``tools/metrics_query.py``
 * ``STATUS`` / ``LOG`` — the monitor's dashboard verbs, so
   ``python -m maggy_tpu.monitor <host:port> <secret> --dashboard`` renders a
   live serving panel with zero monitor-side configuration.
@@ -57,6 +60,7 @@ class ServeServer:
             ("LOG", self._on_log),
         ):
             self._rpc.register_callback(verb, handler)
+        self._rpc.register_metrics(self._metrics_body)
 
     @property
     def secret(self) -> str:
@@ -116,6 +120,14 @@ class ServeServer:
 
     def _on_stats(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return {"type": "SSTATS", **self.scheduler.stats()}
+
+    def _metrics_body(self) -> Dict[str, Any]:
+        sched = self.scheduler
+        return {
+            "scope": "worker",
+            "metrics": sched.metrics.snapshot(),
+            "alerts": sched.alerts.firing() + sched.sentinel.firing(),
+        }
 
     def _on_status(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """The monitor dashboard's STATUS shape, serving flavour."""

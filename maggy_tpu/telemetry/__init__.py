@@ -23,6 +23,13 @@ structured layer every tier threads through:
   bounded event rings plus thread-stack dumps when a progress loop wedges.
 * :mod:`maggy_tpu.telemetry.metrics` — the checked-in metric-name registry
   ``tools/check_telemetry_names.py`` enforces.
+* :mod:`maggy_tpu.telemetry.timeseries` — bounded ring-buffer series sampled
+  from the recorder on a fixed tick, with windowed ``rate``/``delta``/
+  percentile queries and a versioned snapshot form (the ``METRICS`` RPC
+  payload and the autoscaler's input substrate).
+* :mod:`maggy_tpu.telemetry.alerts` — the checked-in alert-rule registry
+  (threshold + for-duration, multi-window SLO burn rate) evaluated per
+  worker and at fleet scope, plus the recompile sentinel.
 
 Wiring: executors build a worker recorder (:func:`worker_telemetry`), install
 it as the thread-ambient recorder (``Trainer.fit`` and ``Checkpointer`` pick
@@ -33,8 +40,10 @@ driver folds into STATUS for the live monitor panel.
 
 from __future__ import annotations
 
-from maggy_tpu.telemetry import flightrec, tracing  # noqa: F401
+from maggy_tpu.telemetry import alerts, flightrec, timeseries, tracing  # noqa: F401
+from maggy_tpu.telemetry.alerts import AlertEvaluator, RecompileSentinel  # noqa: F401
 from maggy_tpu.telemetry.histogram import LatencyHistogram  # noqa: F401
+from maggy_tpu.telemetry.timeseries import Series, SeriesStore  # noqa: F401
 from maggy_tpu.telemetry.recorder import (  # noqa: F401
     NULL,
     NullTelemetry,
@@ -58,6 +67,12 @@ __all__ = [
     "telemetry_dir",
     "worker_telemetry",
     "LatencyHistogram",
+    "Series",
+    "SeriesStore",
+    "AlertEvaluator",
+    "RecompileSentinel",
     "tracing",
     "flightrec",
+    "timeseries",
+    "alerts",
 ]

@@ -197,6 +197,16 @@ class Watchdog:
             "events": rec_mod.flight_snapshots(),
             "threads": thread_stacks(),
         }
+        # embed the firing alert set and the recent samples of the metrics
+        # those alerts name, so a stall dump is self-describing
+        try:
+            from maggy_tpu.telemetry import alerts as alerts_mod
+
+            payload["alerts"] = alerts_mod.active_alerts()
+            payload["alert_series"] = alerts_mod.alerted_series_tails()
+        except Exception:
+            payload["alerts"] = []
+            payload["alert_series"] = {}
         self.last_dump = payload
         rec_mod.get().count("flightrec.dumps")
         if self.dump_dir is None or len(self.dumps) >= MAX_DUMPS:

@@ -69,6 +69,8 @@ GAUGES = frozenset(
         "resilience.membership_epoch",  # current membership epoch
         "resilience.active_slices",  # slices currently in the data mesh
         "resilience.reshape_ms",  # epoch bump -> reshape barrier complete
+        # alerting (telemetry/alerts.py)
+        "alerts.firing",  # alerts currently firing at this scope
     }
 )
 
@@ -101,6 +103,12 @@ COUNTERS = frozenset(
         "tune.cache_hits",
         "tune.cache_misses",
         "flightrec.dumps",  # stall watchdog dumps written (telemetry/flightrec.py)
+        # series-only SLO attainment counters (telemetry/timeseries.py):
+        # ingested into the time-series store from scheduler/router SLO
+        # accounting, never emitted via tel.count — registered so alert
+        # rules and metrics_query resolve them with units
+        "serve.slo_ok",  # requests that met the TTFT SLO
+        "serve.slo_miss",  # requests that missed the TTFT SLO
         # autopilot online controller (autopilot/controller.py)
         "autopilot.diagnoses",  # windows classified
         "autopilot.retunes",  # guarded moves committed
@@ -150,6 +158,10 @@ EVENTS = frozenset(
         "autopilot.committed",
         "autopilot.rollback",
         "autopilot.reconfigure_failed",
+        # alert rule transitions (telemetry/alerts.py; the rule name rides
+        # in the ``alert=`` attr and must exist in alerts.RULES — linted)
+        "alert.firing",
+        "alert.resolved",
     }
 )
 
@@ -171,3 +183,57 @@ BY_KIND = {
 }
 
 ALL = GAUGES | COUNTERS | HISTOGRAMS | EVENTS
+
+# ---------------------------------------------------------------- units
+# Every registered name carries a unit so downstream consumers (monitor
+# sparklines, tools/metrics_query.py, the docs signal table) can label and
+# scale values without guessing. The lint fails on any registered name
+# missing from UNITS or carrying an unknown unit.
+VALID_UNITS = frozenset({"ms", "count", "bytes", "ratio", "per_s"})
+
+# counters and events are dimensionally counts; histograms are all latency
+# distributions in ms. Gauges are mixed, so each is mapped explicitly —
+# adding a gauge means adding its unit here too.
+GAUGE_UNITS = {
+    "step_time_ms": "ms",
+    "step_time_ms_mean": "ms",
+    "compile_time_ms": "ms",
+    "steps_per_sec": "per_s",
+    "tokens_per_sec": "per_s",
+    "mfu_est": "ratio",
+    "metrics_lag": "count",
+    "metrics_drain_ms": "ms",
+    "resumed_step": "count",
+    "input_wait_ms": "ms",
+    "prefetch_depth": "count",
+    "checkpoint_save_ms": "ms",
+    "heartbeat_rtt_ms": "ms",
+    "data_plane_init_ms": "ms",
+    "driver_connect_ms": "ms",
+    "serve.ttft_ms": "ms",
+    "serve.tokens_per_sec": "per_s",
+    "serve.queue_depth": "count",
+    "serve.active_slots": "count",
+    "serve.drain_ms": "ms",
+    "serve.decode_retraces": "count",
+    "serve.prefill_retraces": "count",
+    "serve.pages_free": "count",
+    "serve.pages_shared": "count",
+    "fleet.healthy_replicas": "count",
+    "serve.handoff_ms": "ms",
+    "tune.candidates": "count",
+    "tune.pruned_oom": "count",
+    "tune.best_step_time": "ms",
+    "train.bucket_count": "count",
+    "train.comm_exposed_ms": "ms",
+    "train.comm_overlapped_ms": "ms",
+    "autopilot.tick_ms": "ms",
+    "resilience.membership_epoch": "count",
+    "resilience.active_slices": "count",
+    "resilience.reshape_ms": "ms",
+    "alerts.firing": "count",
+}
+
+UNITS = {name: "count" for name in COUNTERS | EVENTS}
+UNITS.update({name: "ms" for name in HISTOGRAMS})
+UNITS.update(GAUGE_UNITS)

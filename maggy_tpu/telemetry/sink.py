@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import posixpath
+import threading
 from typing import Any, Dict, List, Optional
 
 # remote (object-store) sinks cap the republished history; oldest records are
@@ -62,6 +63,10 @@ class JsonlSink:
         self._truncated = 0
         self._closed = False
         self._size: Optional[int] = None  # lazy: current segment's byte size
+        # a sink may be shared by recorders flushing from different threads;
+        # the rotate-then-append sequence must be atomic or a rotation racing
+        # a write drops/interleaves records
+        self._lock = threading.Lock()
 
     @property
     def env(self):
@@ -94,29 +99,32 @@ class JsonlSink:
         ]
         try:
             if self._remote:
-                self._history.extend(lines)
-                if len(self._history) > _REMOTE_MAX_RECORDS:
-                    dropped = len(self._history) - _REMOTE_MAX_RECORDS
-                    self._history = self._history[dropped:]
-                    self._truncated += dropped
-                head = (
-                    [json.dumps({"kind": "truncated", "dropped": self._truncated})]
-                    if self._truncated
-                    else []
-                )
-                self.env.dump("\n".join(head + self._history) + "\n", self.path)
+                with self._lock:
+                    self._history.extend(lines)
+                    if len(self._history) > _REMOTE_MAX_RECORDS:
+                        dropped = len(self._history) - _REMOTE_MAX_RECORDS
+                        self._history = self._history[dropped:]
+                        self._truncated += dropped
+                    head = (
+                        [json.dumps({"kind": "truncated", "dropped": self._truncated})]
+                        if self._truncated
+                        else []
+                    )
+                    body = "\n".join(head + self._history) + "\n"
+                self.env.dump(body, self.path)
             else:
                 data = "\n".join(lines) + "\n"
-                if self._size is None:  # first write: adopt an existing file
-                    try:
-                        self._size = os.path.getsize(self.path)
-                    except OSError:
-                        self._size = 0
-                if self._size and self._size + len(data) > self.max_bytes:
-                    self._rotate()
-                with self.env.open_file(self.path, "a") as f:
-                    f.write(data)
-                self._size += len(data)
+                with self._lock:
+                    if self._size is None:  # first write: adopt an existing file
+                        try:
+                            self._size = os.path.getsize(self.path)
+                        except OSError:
+                            self._size = 0
+                    if self._size and self._size + len(data) > self.max_bytes:
+                        self._rotate()
+                    with self.env.open_file(self.path, "a") as f:
+                        f.write(data)
+                    self._size += len(data)
         except Exception:  # noqa: BLE001 - telemetry is best-effort, never fatal
             pass
 

@@ -205,6 +205,12 @@ class Trainer:
 
     def __post_init__(self):
         self._train_step = None
+        # trace-time compile counter: the jitted step bodies bump this as a
+        # Python side effect, so it counts XLA traces, not calls (the same
+        # contract as Engine._decode_traces). _expect_recompile marks a
+        # deliberate (re)build so the recompile sentinel stays quiet for it.
+        self._step_traces = 0
+        self._expect_recompile = False
         self._eval_step = None
         self._eval_loss_step = None
         self.state_shardings = None
@@ -395,6 +401,7 @@ class Trainer:
             return vec
 
         def train_step(state: TrainState, batch):
+            self._step_traces += 1  # trace-time: counts compiles, not calls
             # plan from traced shapes: static at trace time, rebuilt free on
             # recompile, never stored host-side
             plan = overlap.plan_buckets(state.params, bucket_mb, pad_to=dz)
@@ -909,6 +916,7 @@ class Trainer:
         dpf = shape.get(shd.AXIS_DATA, 1) * shape.get(shd.AXIS_FSDP, 1)
 
         def train_step(state: TrainState, batch):
+            self._step_traces += 1  # trace-time: counts compiles, not calls
             split_raw, tgts, loss_pp = self._pp_batch_parts(
                 batch, parts, n_micro, dpf
             )
@@ -949,6 +957,8 @@ class Trainer:
             return self._build_overlap_train_step(mode, manual, dz)
 
         def train_step(state: TrainState, batch):
+            self._step_traces += 1  # trace-time: counts compiles, not calls
+
             def loss_of(params):
                 # mutable intermediates so modules can sow auxiliary losses
                 # (MoE router balancing); "*aux_loss" leaves are added to the
@@ -1064,9 +1074,17 @@ class Trainer:
         ):
             self._train_step = None  # n_microbatches changed: recompile
         if self._train_step is None:
+            self._expect_recompile = True  # deliberate build: sentinel-sanctioned
             self._train_step = self._build_train_step()
         with self.mesh:
             return self._train_step(state, batch)
+
+    @property
+    def compile_counts(self) -> Dict[str, int]:
+        """Compile count per jitted program (recompile-sentinel input): a
+        bump without a preceding deliberate rebuild means XLA silently
+        retraced — usually a drifting batch shape."""
+        return {"train_step": self._step_traces}
 
     def eval_logits(self, state: TrainState, batch):
         """Full logits for one batch.
@@ -1373,6 +1391,17 @@ class Trainer:
         # risks a harmless diagnostic dump.
         wd = _flightrec.get()
         wd.begin("train.step", detail=step0)
+        # recompile sentinel + time-series sampling (docs/observability.md):
+        # the jitted step bumps a trace-time counter; a bump without a
+        # deliberate rebuild means XLA silently retraced (usually a drifting
+        # batch shape) and costs a full compile mid-run — alert, don't guess.
+        # The store samples the recorder on its ~1 s tick (one clock compare
+        # per step otherwise).
+        from maggy_tpu.telemetry import timeseries as _timeseries
+        from maggy_tpu.telemetry.alerts import RecompileSentinel as _Sentinel
+
+        ts_store = _timeseries.SeriesStore()
+        sentinel = _Sentinel(ts_store, tel, scope="worker", steady=("train_step",))
         try:
             for i in range(num_steps):  # hot-loop (tools/check_host_sync.py)
                 wd.beat("train.step", detail=step0 + i)
@@ -1434,6 +1463,11 @@ class Trainer:
                 else:
                     step_ms_sum += dt_ms
                     tel.gauge("step_time_ms", dt_ms)
+                if self._expect_recompile:
+                    sentinel.expect("train_step")
+                    self._expect_recompile = False
+                sentinel.observe(self.compile_counts, watchdog=wd)
+                ts_store.maybe_sample(tel)
                 # lagged metrics window: refs sit here `window` steps before
                 # anything host-reads them, so broadcasts touch only results
                 # the device has long finished — never the dispatch frontier

@@ -19,6 +19,14 @@ data. This lint makes the name set closed:
   - an f-string name's leading literal must match a dynamic prefix;
   - anything else (a plain variable) is skipped — it cannot be checked
     statically, and the codebase passes literals everywhere that matters.
+* Every registered name must carry a unit (``metrics.UNITS``, values from
+  ``metrics.VALID_UNITS``) — so consumers (monitor, metrics_query, docs)
+  never guess at scaling.
+* ``maggy_tpu/telemetry/alerts.py`` is loaded the same way and its rule
+  registry validated: unique ``alert.``-prefixed names, known
+  kind/severity/scope, referenced metrics registered. Any *other*
+  ``"alert.*"`` string literal in the tree must name a registered rule or
+  transition event — a typo'd rule name must not mint a phantom alert.
 
 Usage: ``python tools/check_telemetry_names.py [root]`` — exits nonzero
 listing violations. Wired into the tier-1 run via ``tests/test_tracing.py``,
@@ -45,6 +53,78 @@ def load_registry(repo: str):
     return mod
 
 
+def load_alerts(repo: str):
+    """Load the alert-rule registry by path (stdlib-only, like metrics.py)."""
+    path = os.path.join(repo, "maggy_tpu", "telemetry", "alerts.py")
+    spec = importlib.util.spec_from_file_location("maggy_tpu_alerts_registry", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves field types through sys.modules[cls.__module__]
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def check_units(registry) -> List[str]:
+    """Every registered name carries a known unit; no stale unit entries."""
+    out: List[str] = []
+    units = getattr(registry, "UNITS", None)
+    valid = getattr(registry, "VALID_UNITS", None)
+    if units is None or valid is None:
+        return ["metrics.py must define UNITS and VALID_UNITS"]
+    for name in sorted(registry.ALL):
+        unit = units.get(name)
+        if unit is None:
+            out.append(f"{name}: no unit — add it to UNITS in telemetry/metrics.py")
+        elif unit not in valid:
+            out.append(f"{name}: unknown unit {unit!r} (valid: {sorted(valid)})")
+    for name in sorted(units):
+        if name not in registry.ALL:
+            out.append(f"UNITS entry {name!r} is not a registered metric")
+    return out
+
+
+def check_alert_registry(alerts, registry) -> List[str]:
+    """Structural validation of the checked-in alert rules."""
+    out: List[str] = []
+    rules = getattr(alerts, "RULES", ())
+    if len({r.name for r in rules}) != len(rules):
+        out.append("duplicate rule names in alerts.RULES")
+    for r in rules:
+        where = f"alerts.RULES[{r.name!r}]"
+        if not r.name.startswith("alert."):
+            out.append(f"{where}: name must start with 'alert.'")
+        if r.kind not in alerts.KINDS:
+            out.append(f"{where}: unknown kind {r.kind!r}")
+        if r.severity not in alerts.SEVERITIES:
+            out.append(f"{where}: unknown severity {r.severity!r}")
+        if r.scope not in alerts.SCOPES:
+            out.append(f"{where}: unknown scope {r.scope!r}")
+        if not r.summary:
+            out.append(f"{where}: empty summary")
+        if r.kind == "threshold" and not r.metric:
+            out.append(f"{where}: threshold rule needs a metric")
+        if r.kind == "burn_rate":
+            counter_pair = bool(r.ok_metric) and bool(r.miss_metric)
+            hist_src = bool(r.metric) and r.slo_ms is not None
+            if not (counter_pair or hist_src):
+                out.append(
+                    f"{where}: burn_rate rule needs ok/miss counters or metric+slo_ms"
+                )
+            if not r.windows:
+                out.append(f"{where}: burn_rate rule needs windows")
+            if not 0.0 < r.objective < 1.0:
+                out.append(f"{where}: objective must be in (0, 1)")
+        for m in r.metrics():
+            if m not in registry.ALL and not any(
+                m.startswith(p) for p in registry.DYNAMIC_PREFIXES
+            ):
+                out.append(f"{where}: references unregistered metric {m!r}")
+    for ev in (alerts.ALERT_FIRING, alerts.ALERT_RESOLVED):
+        if ev not in registry.EVENTS:
+            out.append(f"transition event {ev!r} missing from metrics.EVENTS")
+    return out
+
+
 def _receiver_is_telemetry(expr: ast.AST) -> bool:
     """True when the call receiver plausibly is a telemetry recorder: some
     identifier in its chain contains 'tel'. Keeps ``"abc".count("a")`` and
@@ -57,10 +137,26 @@ def _receiver_is_telemetry(expr: ast.AST) -> bool:
     return False
 
 
-def check_source(source: str, path: str, registry) -> List[Tuple[int, str]]:
+def check_source(source: str, path: str, registry, alert_names=None) -> List[Tuple[int, str]]:
     out: List[Tuple[int, str]] = []
     tree = ast.parse(source, filename=path)
     for node in ast.walk(tree):
+        if (
+            alert_names is not None
+            and isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith("alert.")
+            and node.value != "alert."  # the bare prefix (strip/match code)
+            and node.value not in alert_names
+        ):
+            out.append(
+                (
+                    node.lineno,
+                    f"{node.value!r} is not a registered alert rule or "
+                    "transition event — add it to telemetry/alerts.py RULES "
+                    "or fix the typo",
+                )
+            )
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
@@ -103,7 +199,7 @@ def check_source(source: str, path: str, registry) -> List[Tuple[int, str]]:
     return out
 
 
-def check_tree(root: str, registry) -> List[Tuple[str, int, str]]:
+def check_tree(root: str, registry, alert_names=None) -> List[Tuple[str, int, str]]:
     violations: List[Tuple[str, int, str]] = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [
@@ -119,7 +215,7 @@ def check_tree(root: str, registry) -> List[Tuple[str, int, str]]:
             except OSError:
                 continue
             try:
-                hits = check_source(source, path, registry)
+                hits = check_source(source, path, registry, alert_names)
             except SyntaxError as e:
                 violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
                 continue
@@ -132,7 +228,19 @@ def main(argv=None) -> int:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     root = args[0] if args else os.path.join(repo, "maggy_tpu")
     registry = load_registry(repo)
-    violations = check_tree(root, registry)
+    alerts = load_alerts(repo)
+    violations: List[Tuple[str, int, str]] = []
+    reg_path = os.path.join(repo, "maggy_tpu", "telemetry", "metrics.py")
+    violations.extend((reg_path, 0, what) for what in check_units(registry))
+    alerts_path = os.path.join(repo, "maggy_tpu", "telemetry", "alerts.py")
+    violations.extend(
+        (alerts_path, 0, what) for what in check_alert_registry(alerts, registry)
+    )
+    alert_names = {r.name for r in alerts.RULES} | {
+        alerts.ALERT_FIRING,
+        alerts.ALERT_RESOLVED,
+    }
+    violations.extend(check_tree(root, registry, alert_names))
     for path, line, what in violations:
         print(f"{path}:{line}: {what}", file=sys.stderr)
     if violations:

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Query exported time-series snapshots from the command line.
+
+Works on any JSON a ``METRICS`` RPC verb (or ``SeriesStore.snapshot()``)
+produced — a worker's store, the router's fleet-aggregate store, or the
+router's full reply carrying per-replica stores. Windowed queries run the
+SAME code as the live system (:mod:`maggy_tpu.telemetry.timeseries`), so a
+percentile computed here over exported per-replica snapshots reproduces the
+router's fleet-merged number exactly (bucket addition commutes with the
+windowed subtraction when ticks align — which the router's single-timestamp
+sampling guarantees).
+
+Usage::
+
+    python tools/metrics_query.py SNAP.json --list
+    python tools/metrics_query.py SNAP.json --name serve.ttft_ms --q 0.95 --window 30
+    python tools/metrics_query.py SNAP.json --name serve.slo_miss --rate --window 30
+    python tools/metrics_query.py --merge R0.json R1.json --name serve.ttft_ms --q 0.95 --window 30
+
+``SNAP.json`` may be a bare store snapshot (``{"v": 1, "series": ...}``), a
+METRICS reply (``{"metrics": ..., "replicas": ...}``), or — with
+``--replica N`` — one replica's store out of a fleet reply. Everything
+prints as one JSON object per invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+from maggy_tpu.telemetry.timeseries import (  # noqa: E402
+    SeriesStore,
+    merge_windowed_percentile,
+)
+
+
+def load_store(path: str, replica: Optional[str] = None) -> SeriesStore:
+    """Load one store from a snapshot file, unwrapping METRICS replies."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if replica is not None:
+        replicas = doc.get("replicas") or {}
+        if str(replica) not in replicas:
+            raise KeyError(
+                f"{path}: no replica {replica!r} (have {sorted(replicas)})"
+            )
+        doc = replicas[str(replica)]
+    elif "series" not in doc and isinstance(doc.get("metrics"), dict):
+        doc = doc["metrics"]
+    return SeriesStore.from_snapshot(doc)
+
+
+def query(
+    stores: List[SeriesStore],
+    name: str,
+    window_s: float,
+    q: Optional[float] = None,
+    rate: bool = False,
+    now: Optional[float] = None,
+) -> dict:
+    """One windowed query over one or many stores (many = fleet merge)."""
+    out: dict = {"name": name, "window_s": window_s}
+    if len(stores) > 1:
+        if q is None:
+            raise SystemExit("--merge requires --q (histogram merge only)")
+        out["merged_from"] = len(stores)
+        out[f"p{int(q * 100)}"] = merge_windowed_percentile(
+            stores, name, q, window_s, now
+        )
+        return out
+    s = stores[0].get(name)
+    if s is None:
+        raise SystemExit(f"no series {name!r} (try --list)")
+    out["kind"] = s.kind
+    out["points"] = len(s)
+    latest = s.latest()
+    if latest is not None and s.kind != "hist":
+        out["latest"] = latest[1]
+    if s.kind == "hist":
+        for qq in ((q,) if q is not None else (0.5, 0.95, 0.99)):
+            out[f"p{int(qq * 100)}"] = s.percentile(qq, window_s, now)
+    elif rate or s.kind == "counter":
+        out["delta"] = s.delta(window_s, now)
+        out["rate_per_s"] = s.rate(window_s, now)
+    return out
+
+
+def list_series(store: SeriesStore) -> dict:
+    return {
+        "series": [
+            {"name": name, "kind": store.get(name).kind, "points": len(store.get(name))}
+            for name in store.names()
+        ]
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("snapshot", nargs="?", help="snapshot JSON (store or METRICS reply)")
+    p.add_argument("--merge", nargs="+", metavar="SNAP",
+                   help="merge these per-replica snapshots (fleet percentile)")
+    p.add_argument("--replica", help="pick one replica store out of a fleet reply")
+    p.add_argument("--list", action="store_true", help="list series and exit")
+    p.add_argument("--name", help="series to query")
+    p.add_argument("--window", type=float, default=60.0, help="window seconds")
+    p.add_argument("--q", type=float, help="percentile (0..1) for hist series")
+    p.add_argument("--rate", action="store_true", help="per-second rate over the window")
+    p.add_argument("--now", type=float, help="window end (default: newest point)")
+    args = p.parse_args(argv)
+
+    if args.merge:
+        stores = [load_store(path, args.replica) for path in args.merge]
+    elif args.snapshot:
+        stores = [load_store(args.snapshot, args.replica)]
+    else:
+        p.error("need a snapshot file or --merge")
+    if args.list:
+        print(json.dumps(list_series(stores[0]), indent=2))
+        return 0
+    if not args.name:
+        p.error("--name required unless --list")
+    result = query(
+        stores, args.name, args.window, q=args.q, rate=args.rate, now=args.now
+    )
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
