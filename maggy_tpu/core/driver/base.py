@@ -123,8 +123,10 @@ class Driver(ABC):
             self.init()
             self._launch_executors(train_fn)
             self._await_completion()
-            if self.exception is not None:
-                raise self.exception
+            with self.lock:
+                exc = self.exception
+            if exc is not None:
+                raise exc
             self._exp_final_callback()
             self.duration = time.time() - self.job_start
             self._write_state("FINISHED")

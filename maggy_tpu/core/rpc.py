@@ -165,13 +165,18 @@ class Server:
         self.telemetry = None
         self.message_queue: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.callbacks: Dict[str, Callable[[Dict[str, Any]], Dict[str, Any]]] = {}
-        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # the four attributes below are published by the server thread
+        # before it calls _started.set(); every other-thread reader first
+        # waits on the Event, so the Event's release/acquire pair orders
+        # the writes before the reads (stop() additionally only hands
+        # _loop to call_soon_threadsafe, the documented thread-safe seam)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None  # race: ok — published before _started.set(); readers wait on the Event
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._started = threading.Event()
-        self._start_error: Optional[BaseException] = None
-        self.host = "127.0.0.1"
-        self.port = 0
+        self._start_error: Optional[BaseException] = None  # race: ok — published before _started.set(); readers wait on the Event
+        self.host = "127.0.0.1"  # race: ok — published before _started.set(); readers wait on the Event
+        self.port = 0  # race: ok — published before _started.set(); readers wait on the Event
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -413,9 +418,9 @@ class Client:
         # MembershipMonitor here; every beat then reports the epoch the
         # worker is running under, and a RESHAPE reply signals the monitor
         self.membership = None
-        self._main_sock = self._connect()
+        self._main_sock = self._connect()  # guarded-by: _main_lock
         self._main_lock = threading.Lock()
-        self._hb_sock: Optional[socket.socket] = None
+        self._hb_sock: Optional[socket.socket] = None  # race: ok — heartbeat-thread-confined between start_heartbeat() and the post-join close in stop()
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
 
@@ -453,8 +458,8 @@ class Client:
                     reply = recv_frame(self._hb_sock)
                 else:
                     with self._main_lock:
-                        send_frame(self._main_sock, msg)
-                        reply = recv_frame(self._main_sock)
+                        send_frame(self._main_sock, msg)  # blocking: ok — _main_lock exists to serialize whole round-trips on the shared main socket
+                        reply = recv_frame(self._main_sock)  # blocking: ok — _main_lock exists to serialize whole round-trips on the shared main socket
                 if tel is not None:
                     tel.rpc(verb, (time.perf_counter() - t0) * 1e3)
                 if reply.get("type") == "ERR":
@@ -615,7 +620,7 @@ class Client:
         self._hb_stop.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=2 * self.hb_interval + 5)
-        for sock in (self._hb_sock, self._main_sock):
+        for sock in (self._hb_sock, self._main_sock):  # race: ok — shutdown path after hb join; a racing close raises OSError, swallowed below
             if sock is not None:
                 try:
                     sock.close()

@@ -240,7 +240,7 @@ class MembershipMonitor:
     def inactive(self) -> Tuple[int, ...]:
         return self.view.inactive
 
-    def signal(self, epoch: Any) -> None:
+    def signal(self, epoch: Any) -> None:  # thread-entry — the rpc heartbeat thread signals RESHAPE replies
         """Note that the driver is at a newer epoch (heartbeat thread)."""
         try:
             epoch = int(epoch)

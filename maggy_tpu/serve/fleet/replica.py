@@ -21,9 +21,10 @@ a kill, charged against the router's restart budget.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
+
+from maggy_tpu.core import lockdebug
 
 # replica lifecycle states (the quarantine overlay lives in the router's
 # QuarantineTracker, not here — a replica can be UP yet quarantined)
@@ -86,7 +87,7 @@ class Replica:
         self.addr: Optional[Tuple[str, int]] = None
         self.server = None  # ServeServer
         self.client = None  # router-owned ServeClient
-        self._lock = threading.Lock()
+        self._lock = lockdebug.lock("replica._lock")
 
     # -------------------------------------------------------------- lifecycle
 
@@ -120,14 +121,16 @@ class Replica:
         # the router's private client: plain single-shot calls — fleet-level
         # failover lives in the router, not in this hop
         self.client = ServeClient(self.addr, self.secret, failover=False)
-        self.state = UP
+        with self._lock:
+            self.state = UP
         self.started_ts = time.time()
         return self.addr
 
     def alive(self) -> bool:
-        return self.state == UP
+        with self._lock:
+            return self.state == UP
 
-    def kill(self) -> None:
+    def kill(self) -> None:  # thread-entry — chaos/pump threads hard-kill replicas
         """Chaos/hard death: close the port first (every in-flight and
         future router call fails the way a preempted host's would), then
         abandon the scheduler without draining."""
@@ -164,7 +167,8 @@ class Replica:
         """Rebuild the full stack after a death (new engine, new port).
         Counts one restart; the router enforces the budget."""
         self.restarts += 1
-        self.state = STARTING
+        with self._lock:
+            self.state = STARTING
         addr = self.start()
         return addr
 
@@ -176,8 +180,9 @@ class Replica:
         it on the event loop (the exact contract ServeServer's own SSTATS
         handler follows). None when the replica is down (or remote, where
         only the probe cache exists)."""
-        if self.state != UP or self.server is None:
-            return None
+        with self._lock:
+            if self.state != UP or self.server is None:
+                return None
         try:
             return self.server.scheduler.stats()
         except Exception:  # noqa: BLE001 - racing a concurrent kill()
@@ -189,8 +194,9 @@ class Replica:
         id, exactly like ``client.submit`` — POLL/CANCEL work unchanged.
         Raises for a remote/dead replica; the router falls back to a plain
         submit (the decode engine prefills for itself)."""
-        if self.state != UP or self.server is None:
-            raise RuntimeError(f"replica {self.index} cannot accept a handoff")
+        with self._lock:
+            if self.state != UP or self.server is None:
+                raise RuntimeError(f"replica {self.index} cannot accept a handoff")
         from maggy_tpu.serve.request import SamplingParams
 
         params = SamplingParams(
@@ -211,16 +217,18 @@ class Replica:
         return req.id
 
     def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            state = self.state
         return {
             "replica": self.index,
             "role": self.spec.role,
-            "state": self.state,
+            "state": state,
             "addr": f"{self.addr[0]}:{self.addr[1]}" if self.addr else None,
             "restarts": self.restarts,
             "devices": [str(d) for d in self.devices],
             "uptime_s": (
                 round(time.time() - self.started_ts, 1)
-                if self.started_ts and self.state == UP
+                if self.started_ts and state == UP
                 else None
             ),
         }

@@ -55,7 +55,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from maggy_tpu import telemetry
-from maggy_tpu.core import rpc
+from maggy_tpu.core import lockdebug, rpc
 from maggy_tpu.exceptions import RpcError, RpcRejectedError
 from maggy_tpu.resilience import chaos as chaos_mod
 from maggy_tpu.resilience.policy import QuarantineTracker
@@ -230,7 +230,7 @@ class Router:
             threshold=self.config.quarantine_threshold,
             cooldown=self.config.quarantine_cooldown_s,
         )
-        self._lock = threading.RLock()
+        self._lock = lockdebug.rlock("router._lock")
         self._entries: Dict[str, RouteEntry] = {}
         self._pending: deque = deque()  # rids; requeues go left, fresh right
         self._stats_cache: Dict[int, Dict[str, Any]] = {}
@@ -352,7 +352,9 @@ class Router:
             and not self.quarantine.is_quarantined(r.index, now)
         ]
 
-    def _pick_replica(self, healthy: List[Replica]) -> Tuple[Replica, float]:
+    def _pick_replica(  # guarded-by: _lock
+        self, healthy: List[Replica]
+    ) -> Tuple[Replica, float]:
         """Least projected TTFT; round-robin cursor breaks ties so equal
         replicas share load instead of all traffic piling on index 0."""
         cfg = self.config
@@ -470,7 +472,9 @@ class Router:
                 self._finish_local(entry, "cancelled")
         return {"type": "CANCEL", "cancelled": True}
 
-    def _finish_local(self, entry: RouteEntry, state: str, error=None) -> None:
+    def _finish_local(  # guarded-by: _lock
+        self, entry: RouteEntry, state: str, error=None
+    ) -> None:
         """Terminal without a downstream snapshot (lock held)."""
         entry.final = {
             "state": state,
@@ -491,7 +495,7 @@ class Router:
         self.counters[key] += 1
         entry.counted_done = True
 
-    def _fleet_stats(self) -> Dict[str, Any]:
+    def _fleet_stats(self) -> Dict[str, Any]:  # guarded-by: _lock
         """Aggregate + per-replica table (lock held).
 
         Latency is merged honestly: every replica's SSTATS carries its raw
@@ -662,10 +666,10 @@ class Router:
         for idx, stats in cache.items():
             if not stats:
                 continue
-            store = self.replica_metrics.get(idx)
-            if store is None:
-                store = timeseries.SeriesStore(self.metrics.interval_s)
-                with self._lock:
+            with self._lock:
+                store = self.replica_metrics.get(idx)
+                if store is None:
+                    store = timeseries.SeriesStore(self.metrics.interval_s)
                     self.replica_metrics[idx] = store
             hists = {
                 f"serve.{name}": d
@@ -706,7 +710,11 @@ class Router:
         # the sum of replica-side counters stands in otherwise
         counters = {}
         if self.config.slo_ttft_ms is not None:
-            counters = {"serve.slo_ok": self.slo_ok, "serve.slo_miss": self.slo_miss}
+            with self._lock:
+                counters = {
+                    "serve.slo_ok": self.slo_ok,
+                    "serve.slo_miss": self.slo_miss,
+                }
         elif have_replica_slo:
             counters = {"serve.slo_ok": slo_ok_sum, "serve.slo_miss": slo_miss_sum}
         self.metrics.ingest(now, gauges=fleet_gauges, counters=counters, hists=merged_hists)

@@ -34,6 +34,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from maggy_tpu import telemetry
+from maggy_tpu.core import lockdebug
 from maggy_tpu.exceptions import BadArgumentsError
 from maggy_tpu.serve import request as rq
 from maggy_tpu.serve.engine import Engine
@@ -89,7 +90,7 @@ class Scheduler:
                     telemetry_recorder=self.telemetry,
                 )
             )
-        self._lock = threading.RLock()
+        self._lock = lockdebug.rlock("scheduler._lock")
         self._wake = threading.Condition(self._lock)
         self._queue: deque = deque()  # FCFS: append right, pop left
         self._requests: Dict[str, Request] = {}
@@ -246,12 +247,14 @@ class Scheduler:
 
     def reconfigure_pending(self) -> bool:
         """True while a requested slot-geometry change awaits the drain."""
-        return self._pending_slots is not None
+        with self._lock:
+            return self._pending_slots is not None
 
     def _maybe_reconfigure(self) -> None:
         """Apply a pending slot change once the active set has drained
         (loop thread only)."""
-        target = self._pending_slots
+        with self._lock:
+            target = self._pending_slots
         if target is None or self.engine.slots.active_count:
             return
         try:
@@ -264,7 +267,11 @@ class Scheduler:
                 "autopilot.reconfigure_failed",
                 num_slots=target, error=f"{type(e).__name__}: {e}",
             )
-        self._pending_slots = None
+        with self._lock:
+            # compare-and-clear: a newer slot request that landed while this
+            # reconfigure ran must not be silently clobbered
+            if self._pending_slots == target:
+                self._pending_slots = None
 
     def _metrics_tick(self, now: float, wd=None) -> None:
         """One observability tick (loop thread, ~1 Hz with the flush):
@@ -272,11 +279,13 @@ class Scheduler:
         feed compile counts to the sentinel, run the alert rules."""
         self.metrics.sample(self.telemetry, now)
         if self.slo_ttft_ms is not None:
+            with self._lock:
+                slo_ok, slo_miss = self.slo_ok, self.slo_miss
             self.metrics.ingest(
                 now,
                 counters={
-                    "serve.slo_ok": self.slo_ok,
-                    "serve.slo_miss": self.slo_miss,
+                    "serve.slo_ok": slo_ok,
+                    "serve.slo_miss": slo_miss,
                 },
             )
         self.sentinel.observe(self.engine.compile_counts, now, watchdog=wd)
@@ -373,7 +382,9 @@ class Scheduler:
 
     # ------------------------------------------------------------ engine loop
 
-    def _finish(self, req: Request, state: str, error: Optional[str] = None) -> None:
+    def _finish(  # guarded-by: _lock
+        self, req: Request, state: str, error: Optional[str] = None
+    ) -> None:
         req.finish(state, error)
         key = {
             rq.DONE: "done",
@@ -395,7 +406,7 @@ class Scheduler:
             n_tokens=len(req.tokens), e2e_ms=req.e2e_ms,
         )
 
-    def _emit(self, req: Request, token: int, now: float) -> bool:
+    def _emit(self, req: Request, token: int, now: float) -> bool:  # guarded-by: _lock
         """Append a generated token; True when the request just finished."""
         req.tokens.append(int(token))
         if req.first_token_ts is None:
@@ -428,8 +439,9 @@ class Scheduler:
         admission pauses until running requests finish or preemption frees
         pages — no request is ever refused for memory pressure (only a
         request that could never fit fails, at submit)."""
-        if self._pending_slots is not None:
-            return  # drain-and-reconfigure in progress: let the wave empty
+        with self._lock:
+            if self._pending_slots is not None:
+                return  # drain-and-reconfigure in progress: let the wave empty
         while self.engine.slots.free_slots():
             with self._lock:
                 if not self._queue:
@@ -614,11 +626,13 @@ class Scheduler:
                     if finished:
                         self._release_slot(slot)
                 rate = len(out.tokens) / dt if dt > 0 else 0.0
-                self._tok_rate_ema = (
-                    rate if self._tok_rate_ema == 0.0
-                    else 0.9 * self._tok_rate_ema + 0.1 * rate
-                )
-                tel.gauge("serve.tokens_per_sec", self._tok_rate_ema)
+                with self._lock:
+                    self._tok_rate_ema = (
+                        rate if self._tok_rate_ema == 0.0
+                        else 0.9 * self._tok_rate_ema + 0.1 * rate
+                    )
+                    ema = self._tok_rate_ema
+                tel.gauge("serve.tokens_per_sec", ema)
             else:
                 # async decode leaves the last dispatch in flight when the
                 # active set empties (its rows all belong to finished

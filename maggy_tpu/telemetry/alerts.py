@@ -206,8 +206,8 @@ class AlertEvaluator:
             for r in (rules if rules is not None else RULES)
             if r.kind != "sentinel" and r.scope in ("any", scope)
         )
-        self._pending: Dict[str, float] = {}  # rule -> condition-true since
-        self._firing: Dict[str, Dict[str, Any]] = {}
+        self._pending: Dict[str, float] = {}  # rule -> condition-true since  # race: ok — single-writer (owner tick thread); never read off-thread
+        self._firing: Dict[str, Dict[str, Any]] = {}  # race: ok — single-writer (owner tick); firing() copies dicts under the GIL
         _EVALUATORS.add(self)
 
     # ------------------------------------------------------------------- read
@@ -217,7 +217,7 @@ class AlertEvaluator:
 
     # ------------------------------------------------------------------- tick
 
-    def evaluate(self, now: Optional[float] = None, watchdog=None) -> List[Dict[str, Any]]:
+    def evaluate(self, now: Optional[float] = None, watchdog=None) -> List[Dict[str, Any]]:  # thread-entry — ticked from the owning scheduler/router thread
         """One evaluation pass; returns the transitions (fired/resolved)."""
         ts = now if now is not None else time.time()
         transitions: List[Dict[str, Any]] = []
@@ -353,9 +353,9 @@ class RecompileSentinel:
         self.scope = scope
         self._tel = recorder
         self._steady = tuple(steady)
-        self._baseline: Dict[str, int] = {}
-        self._expected: set = set()
-        self._tripped: Dict[str, float] = {}  # program -> fired at
+        self._baseline: Dict[str, int] = {}  # race: ok — single-writer (owner tick thread); GIL-atomic dict stores
+        self._expected: set = set()  # race: ok — expect() runs on the owner thread before its own tick observes the counts
+        self._tripped: Dict[str, float] = {}  # program -> fired at  # race: ok — single-writer tick; firing() iterates a list() copy
         _EVALUATORS.add(self)
 
     def expect(self, *programs: str) -> None:
@@ -385,7 +385,7 @@ class RecompileSentinel:
             )
         return out
 
-    def observe(
+    def observe(  # thread-entry — ticked from the owning scheduler/router thread
         self, counts: Dict[str, int], now: Optional[float] = None, watchdog=None
     ) -> List[str]:
         """Record one tick of compile counts; returns programs that tripped."""

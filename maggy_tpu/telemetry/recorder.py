@@ -36,6 +36,7 @@ import weakref
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
+from maggy_tpu.core import lockdebug
 from maggy_tpu.telemetry import tracing
 from maggy_tpu.telemetry.histogram import LatencyHistogram
 
@@ -68,8 +69,8 @@ class Telemetry:
         # bounded tee of the same records for the stall flight recorder —
         # never drained, so a dump always has the recent past
         self.flight: deque = deque(maxlen=FLIGHT_CAPACITY)
-        self._gauges: Dict[str, float] = {}
-        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}  # race: ok — GIL-atomic dict stores, latest-value-wins semantics; snapshot copies are best-effort
+        self._counters: Dict[str, int] = {}  # race: ok — single-writer per key by design (module docstring); rpc_errors.* keys are written only under _rpc_lock
         # name -> fixed-log-bucket latency distribution (single-writer per
         # worker, like counters; snapshot copies under no lock by the same
         # GIL-atomicity argument)
@@ -77,11 +78,11 @@ class Telemetry:
         # verb -> [n, total_ms, max_ms]; the single locked structure (see
         # module docstring) because two threads (worker + heartbeat) write it
         self._rpc: Dict[str, List[float]] = {}
-        self._rpc_lock = threading.Lock()
+        self._rpc_lock = lockdebug.lock("telemetry._rpc_lock")
         self._sink = None
         # flush is called from both the worker thread (trial boundaries) and
         # the heartbeat thread (per beat); serialize so JSONL lines never tear
-        self._flush_lock = threading.Lock()
+        self._flush_lock = lockdebug.lock("telemetry._flush_lock")
         _instances.add(self)
 
     # ------------------------------------------------------------------ spans
@@ -118,7 +119,7 @@ class Telemetry:
 
     # ------------------------------------------------------- gauges / counters
 
-    def gauge(self, name: str, value: float) -> None:
+    def gauge(self, name: str, value: float) -> None:  # thread-entry — heartbeat + scheduler threads record gauges
         """Set a gauge to its latest value (also journaled as an event)."""
         value = float(value)
         self._gauges[name] = value
@@ -132,7 +133,7 @@ class Telemetry:
             }
         )
 
-    def count(self, name: str, n: int = 1) -> None:
+    def count(self, name: str, n: int = 1) -> None:  # thread-entry — scheduler/router threads count from their loops
         """Increment a counter (single-writer per worker by design)."""
         self._counters[name] = self._counters.get(name, 0) + n
 
@@ -162,7 +163,7 @@ class Telemetry:
             h = self._hists.setdefault(name, LatencyHistogram())
         h.observe(value_ms)
 
-    def rpc(self, verb: str, ms: Optional[float] = None, ok: bool = True) -> None:
+    def rpc(self, verb: str, ms: Optional[float] = None, ok: bool = True) -> None:  # thread-entry — worker + heartbeat threads both record RPCs
         """Record one RPC round-trip for ``verb`` (thread-safe)."""
         with self._rpc_lock:
             rec = self._rpc.setdefault(verb, [0, 0.0, 0.0])
@@ -178,7 +179,7 @@ class Telemetry:
 
     # ------------------------------------------------------------------ export
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self) -> Dict[str, Any]:  # thread-entry — the heartbeat thread attaches snapshots to beats
         """Compact aggregate state for heartbeat attachment: latest gauges,
         counters, and per-verb RPC stats — no event history."""
         out: Dict[str, Any] = {"worker": self.worker, "role": self.role, "ts": time.time()}
@@ -215,7 +216,7 @@ class Telemetry:
     def attach_sink(self, sink) -> None:
         self._sink = sink
 
-    def flush(self) -> None:
+    def flush(self) -> None:  # thread-entry — the heartbeat thread flushes every beat
         """Drain buffered events into the attached sink (no-op without one)."""
         if self._sink is None:
             return

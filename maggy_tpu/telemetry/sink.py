@@ -76,7 +76,7 @@ class JsonlSink:
             self._env = EnvSing.get_instance()
         return self._env
 
-    def _rotate(self) -> None:
+    def _rotate(self) -> None:  # guarded-by: _lock
         """Shift-rotate the live file: ``.jsonl`` -> ``.jsonl.1`` -> … up to
         ``max_segments`` (oldest removed). Local filesystem only — the
         remote path bounds history by republishing instead."""
@@ -91,7 +91,7 @@ class JsonlSink:
             os.replace(self.path, f"{self.path}.1")
         self._size = 0
 
-    def write(self, records: List[Dict[str, Any]]) -> None:
+    def write(self, records: List[Dict[str, Any]]) -> None:  # thread-entry — recorders flush from heartbeat/flusher threads
         if self._closed or not records:
             return
         lines = [
@@ -129,8 +129,9 @@ class JsonlSink:
             pass
 
     def close(self) -> None:
-        self._closed = True
-        self._history = []
+        with self._lock:
+            self._closed = True
+            self._history = []
 
 
 def worker_telemetry(partition_id, exp_dir: str, role: str = "worker", env=None):

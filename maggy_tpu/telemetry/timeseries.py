@@ -235,8 +235,11 @@ class SeriesStore:
     ):
         self.interval_s = float(interval_s)
         self.capacity = int(capacity)
-        self._series: Dict[str, Series] = {}
-        self._last_sample = 0.0
+        # single-writer per store: only the owner's metrics tick (scheduler
+        # decode thread / router pump) appends; RPC-side readers copy via
+        # list() and tolerate a tick of staleness
+        self._series: Dict[str, Series] = {}  # race: ok — single-writer (owner tick); GIL-atomic dict stores; readers snapshot via list()
+        self._last_sample = 0.0  # race: ok — single-writer tick gate; a stale read costs one extra compare
 
     # ------------------------------------------------------------------ write
 
@@ -247,7 +250,7 @@ class SeriesStore:
             self._series[name] = s
         return s
 
-    def ingest(
+    def ingest(  # thread-entry — the router pump / scheduler metrics threads feed ticks
         self,
         ts: float,
         gauges: Optional[Dict[str, float]] = None,
@@ -266,7 +269,7 @@ class SeriesStore:
             if d:
                 self.series(name, "hist").append(ts, dict(d))
 
-    def sample(self, recorder, now: Optional[float] = None) -> float:
+    def sample(self, recorder, now: Optional[float] = None) -> float:  # thread-entry — called from the scheduler's decode-loop tick
         """Copy the recorder's current gauges/counters/histograms into the
         rings as one tick. Cheap: dict copies + one ``to_dict`` per live
         histogram; the recorder's single-writer/GIL-atomic contract makes

@@ -106,7 +106,12 @@ class DevicePrefetcher:
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._terminal: Any = None  # _END or _Error once the stream finished
+        # _END or _Error once the stream finished. Consumer-thread-confined:
+        # the producer never touches it — terminal markers travel through
+        # the queue, and __next__ installs them on the consumer side. All
+        # producer<->consumer state rides the Queue/Event (no bare shared
+        # attrs), which is why check_concurrency needs no waivers here.
+        self._terminal: Any = None
         self.wait_ms_total = 0.0
         self.consumed = 0
 

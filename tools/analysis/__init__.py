@@ -1,0 +1,44 @@
+"""Shared static-analysis framework for the ``tools/check_*.py`` lint suite.
+
+Every lint in this repo has the same skeleton: walk a tree of ``.py``
+files, parse each one (AST and/or tokenize), collect ``(path, line, what)``
+violations, print them as ``path:line: what`` to stderr and exit nonzero.
+Seven tools grew seven private copies of that skeleton; this package is the
+single one they all share. See ``docs/static_analysis.md``.
+
+Public surface (``from analysis import ...``):
+
+* :data:`Violation` — the ``(path, line, what)`` tuple every lint emits.
+* :func:`comment_lines` / :func:`marker_lines` — tokenize-based comment
+  maps, the seam for per-site suppression markers (``# sync: ok``,
+  ``# race: ok``, …).
+* :func:`iter_py_files` / :func:`walk_sources` — tree walking with the
+  canonical prune list and per-file SyntaxError→violation handling.
+* :func:`report` — the shared ``main()`` tail: print violations, return
+  the process exit code.
+* :func:`repo_root` — the repo checkout containing this package.
+* :func:`load_module_from_path` — importlib loader for checked-in
+  registries (metrics, alerts, knobs) that must not import the package.
+"""
+
+from .framework import (
+    Violation,
+    comment_lines,
+    iter_py_files,
+    load_module_from_path,
+    marker_lines,
+    report,
+    repo_root,
+    walk_sources,
+)
+
+__all__ = [
+    "Violation",
+    "comment_lines",
+    "iter_py_files",
+    "load_module_from_path",
+    "marker_lines",
+    "report",
+    "repo_root",
+    "walk_sources",
+]

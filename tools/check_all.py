@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Single entry point for every static-analysis lint in tools/.
+
+``python tools/check_all.py`` runs the whole suite and exits nonzero when
+any lint reports violations; ``--list`` prints the registry. Each lint is
+a ``check_*.py`` module exposing ``main(argv=None) -> int`` (0 = clean) —
+the registry below is the authoritative list, and a tier-1 test asserts
+every ``tools/check_*.py`` on disk is registered so a new lint cannot be
+added without joining the suite.
+"""
+import os
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import load_module_from_path  # noqa: E402
+
+# lint name -> one-line purpose; name must match tools/check_<name>.py
+LINTS = {
+    "chaos_kinds": "chaos fault kinds used in tests exist in the registry",
+    "concurrency": "lock discipline: shared state, lock order, blocking under lock",
+    "docs_nav": "every docs/*.md page is reachable from the mkdocs nav",
+    "exception_hygiene": "no silent broad excepts outside the allowlist",
+    "host_sync": "no host-sync (device_get/block_until_ready) in hot regions",
+    "knob_registry": "autopilot knobs referenced in code exist in the registry",
+    "no_bare_print": "no bare print() — output routes through telemetry",
+    "telemetry_names": "telemetry metric/alert names match the registry",
+}
+
+
+def registered_paths():
+    return {
+        name: os.path.join(_TOOLS_DIR, f"check_{name}.py") for name in LINTS
+    }
+
+
+def discovered_paths():
+    return {
+        fn[len("check_"):-len(".py")]: os.path.join(_TOOLS_DIR, fn)
+        for fn in sorted(os.listdir(_TOOLS_DIR))
+        if fn.startswith("check_") and fn.endswith(".py") and fn != "check_all.py"
+    }
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    if "--list" in args:
+        for name, what in sorted(LINTS.items()):
+            print(f"check_{name}: {what}")
+        return 0
+    missing = set(discovered_paths()) - set(LINTS)
+    if missing:
+        for name in sorted(missing):
+            print(
+                f"tools/check_{name}.py exists but is not in check_all.LINTS",
+                file=sys.stderr,
+            )
+        return 1
+    failed = []
+    for name, path in sorted(registered_paths().items()):
+        mod = load_module_from_path(f"check_{name}", path)
+        rc = mod.main([])
+        status = "ok" if rc == 0 else "FAIL"
+        print(f"check_{name}: {status}", file=sys.stderr)
+        if rc != 0:
+            failed.append(name)
+    if failed:
+        print(f"{len(failed)} lint(s) failed: {', '.join(failed)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,8 +22,10 @@ same way ``check_telemetry_names`` closes the metric set:
   Non-literal names/specs are skipped (statically uncheckable).
 
 Usage: ``python tools/check_chaos_kinds.py [root ...]`` — exits nonzero
-listing violations. Wired into tier-1 via ``tests/test_elastic_membership.py``,
-beside the telemetry-name, host-sync, and exception-hygiene lints.
+listing violations. Built on the shared ``tools/analysis`` framework
+(docs/static_analysis.md); wired into tier-1 via
+``tests/test_elastic_membership.py``, beside the telemetry-name,
+host-sync, and exception-hygiene lints.
 """
 
 from __future__ import annotations
@@ -32,6 +34,12 @@ import ast
 import os
 import sys
 from typing import List, Optional, Set, Tuple
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import report, repo_root, walk_sources  # noqa: E402
 
 ENV_VAR = "MAGGY_TPU_CHAOS"
 
@@ -148,50 +156,21 @@ def check_source(source: str, path: str, kinds: Set[str]) -> List[Tuple[int, str
 
 
 def check_tree(roots: List[str], kinds: Set[str]) -> List[Tuple[str, int, str]]:
-    violations: List[Tuple[str, int, str]] = []
-    files: List[str] = []
-    for root in roots:
-        if os.path.isfile(root):
-            files.append(root)
-            continue
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = [
-                d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))
-            ]
-            files.extend(
-                os.path.join(dirpath, n) for n in sorted(filenames) if n.endswith(".py")
-            )
-    for path in files:
-        try:
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
-        except OSError:
-            continue
-        try:
-            hits = check_source(source, path, kinds)
-        except SyntaxError as e:
-            violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
-            continue
-        violations.extend((path, line, what) for line, what in hits)
-    return violations
+    return walk_sources(
+        roots, lambda source, path: check_source(source, path, kinds)
+    )
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = repo_root()
     roots = args or [
         os.path.join(repo, "maggy_tpu"),
         os.path.join(repo, "tests"),
         os.path.join(repo, "bench.py"),
     ]
     kinds = load_kinds(repo)
-    violations = check_tree(roots, kinds)
-    for path, line, what in violations:
-        print(f"{path}:{line}: {what}", file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} violation(s)", file=sys.stderr)
-        return 1
-    return 0
+    return report(check_tree(roots, kinds))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,806 @@
+#!/usr/bin/env python
+"""Lint: lock-discipline race detector for the threaded runtime.
+
+The serving fleet is a web of threads — router pump, scheduler loop,
+prefetcher, telemetry sink/flight-recorder, membership monitor, driver
+heartbeats — and every past race (torn ``Scheduler.stats()``, sink
+rotate-vs-append) was found by accident. This analyzer turns the locking
+conventions into checked invariants. It builds a per-class concurrency
+model from the AST:
+
+* **lock attributes** — ``self.X = threading.Lock()/RLock()/Condition()``
+  (a ``Condition(self.Y)`` shares ``Y``'s lock identity);
+* **thread entry points** — methods passed as ``Thread(target=self.X)``,
+  methods called from a module-level function that is itself a thread
+  target, daemon-loop methods (``*_loop``), and methods carrying a
+  ``# thread-entry`` marker (called directly from a foreign thread);
+* **lock regions** — ``with self.X:`` spans plus whole methods whose
+  ``def`` line carries ``# guarded-by: <lock>`` (caller holds the lock);
+* **attribute reads/writes** — every ``self.attr`` access with the lock
+  set held at that site.
+
+Three checks run over the model:
+
+1. **unguarded shared state** — an attribute written from a thread entry
+   point and touched from any other method must be accessed under a class
+   lock at every site, or be declared ``# guarded-by: <lock>`` on its
+   ``__init__`` assignment (a non-lock guard name documents an external
+   mechanism, e.g. ``queue-internal``), or be suppressed per-site or
+   per-attribute with a justified ``# race: ok — <reason>``.
+2. **lock-order inversion** — the cross-class lock-acquisition graph
+   (lexical nesting plus calls into lock-taking methods, receiver resolved
+   by name hint the way ``check_telemetry_names`` resolves telemetry
+   receivers) must stay acyclic. The serving hierarchy is
+   router → replica → scheduler → recorder. ``# lock-order: ok — <reason>``
+   drops a deliberate edge.
+3. **blocking-under-lock** — RPC requests, socket/frame I/O, ``sleep``,
+   thread ``join`` and ``jax.block_until_ready``/``device_get`` while
+   holding a lock are flagged; ``# blocking: ok — <reason>`` allowlists a
+   bounded, deliberate case (the router invariant "the pump thread owns
+   all downstream sockets, handlers stay lock-only" is machine-checked by
+   this rule).
+
+Every suppression must carry a reason — a bare marker is itself a
+violation. ``REQUIRED_MODELS`` pins the core threaded classes so deleting
+a lock (and with it the model) is a violation, mirroring
+``check_host_sync.REQUIRED_REGIONS``.
+
+Usage: ``python tools/check_concurrency.py [root]`` — exits nonzero
+listing violations. Built on the shared ``tools/analysis`` framework
+(docs/static_analysis.md); wired into the tier-1 run via
+``tests/test_concurrency_lint.py``. The runtime counterpart is
+``maggy_tpu/core/lockdebug.py`` (``MAGGY_TPU_LOCK_ORDER=1``), which
+asserts the same acyclicity on live acquisitions.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import Violation, comment_lines, iter_py_files, report, repo_root  # noqa: E402
+
+GUARDED_BY = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+RACE_OK = re.compile(r"#\s*race:\s*ok\b\s*(.*)")
+LOCK_ORDER_OK = re.compile(r"#\s*lock-order:\s*ok\b\s*(.*)")
+BLOCKING_OK = re.compile(r"#\s*blocking:\s*ok\b\s*(.*)")
+THREAD_ENTRY = re.compile(r"#\s*thread-entry\b")
+
+LOCK_FACTORIES = ("Lock", "RLock", "Condition", "lock", "rlock", "condition")
+LOCKISH = ("lock", "mutex", "cond")
+CONSTRUCTORS = ("__init__", "__post_init__", "__del__")
+
+# (path suffix, class name, lock attribute): the class must exist with that
+# lock and at least one thread entry point — deleting the lock (or the
+# model) is itself a violation, mirroring check_host_sync.REQUIRED_REGIONS.
+REQUIRED_MODELS: Tuple[Tuple[str, str, str], ...] = (
+    (os.path.join("maggy_tpu", "serve", "scheduler.py"), "Scheduler", "_lock"),
+    (os.path.join("maggy_tpu", "serve", "fleet", "router.py"), "Router", "_lock"),
+    (os.path.join("maggy_tpu", "telemetry", "flightrec.py"), "Watchdog", "_lock"),
+    (os.path.join("maggy_tpu", "core", "driver", "base.py"), "Driver", "lock"),
+)
+
+
+def _strip_reason(text: str) -> str:
+    """The justification after a suppression marker, sans separators."""
+    return text.lstrip(" \t—–:-").strip()
+
+
+def _chain(expr: ast.AST) -> List[str]:
+    """Identifiers in an attribute chain, outermost first (``a.b.c`` →
+    ``['a', 'b', 'c']``); empty for non-chain expressions."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _final_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _is_lockish(name: str) -> bool:
+    low = name.lower()
+    return any(part in low for part in LOCKISH)
+
+
+@dataclass
+class Site:
+    """One attribute access or call, with the lock set held there."""
+
+    line: int
+    end_line: int
+    held: Tuple[str, ...]
+    method: str
+    is_write: bool = False
+
+
+@dataclass
+class ClassModel:
+    name: str
+    path: str
+    line: int
+    locks: Dict[str, str] = field(default_factory=dict)  # attr -> canonical attr
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    entries: Set[str] = field(default_factory=set)
+    guards: Dict[str, str] = field(default_factory=dict)  # method -> lock id
+    # attr -> first __init__ assignment line (annotation anchor)
+    decl_lines: Dict[str, int] = field(default_factory=dict)
+    accesses: Dict[str, List[Site]] = field(default_factory=dict)
+    calls: List[Tuple[ast.Call, Tuple[str, ...], str]] = field(default_factory=list)
+    # method -> lock ids it acquires directly (with-regions)
+    direct_acquires: Dict[str, Set[str]] = field(default_factory=dict)
+    # method -> names of self-methods it calls
+    self_calls: Dict[str, Set[str]] = field(default_factory=dict)
+    # (outer lock id, inner lock id, line) from lexical nesting
+    nest_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{self.locks.get(attr, attr)}"
+
+    def thread_reachable(self) -> Set[str]:
+        seen = set(self.entries)
+        frontier = list(seen)
+        while frontier:
+            m = frontier.pop()
+            for callee in self.self_calls.get(m, ()):
+                if callee in self.methods and callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+    def acquire_closure(self, method: str) -> Set[str]:
+        out: Set[str] = set()
+        seen: Set[str] = set()
+        frontier = [method]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            out |= self.direct_acquires.get(m, set())
+            if m in self.guards:
+                out.add(self.guards[m])
+            frontier.extend(
+                c for c in self.self_calls.get(m, ()) if c in self.methods
+            )
+        return out
+
+
+@dataclass
+class ModuleModel:
+    path: str
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    module_locks: Set[str] = field(default_factory=set)
+    # module-level functions that are Thread targets
+    thread_funcs: Set[str] = field(default_factory=set)
+    # calls made inside module-level functions: (func name, callee attr)
+    func_calls: Dict[str, Set[str]] = field(default_factory=dict)
+    # calls/blocking sites in module functions, with held module locks
+    calls: List[Tuple[ast.Call, Tuple[str, ...], str]] = field(default_factory=list)
+    nest_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    comments: Dict[int, str] = field(default_factory=dict)
+
+
+def _lock_ctor_kind(call: ast.Call) -> Optional[str]:
+    """'plain'/'condition' when ``call`` constructs a lock, else None."""
+    name = _final_name(call.func)
+    if name not in LOCK_FACTORIES:
+        return None
+    return "condition" if name.lower() == "condition" else "plain"
+
+
+def _shared_lock_arg(call: ast.Call) -> Optional[str]:
+    """The ``self.X`` a Condition wraps, if any."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        chain = _chain(arg)
+        if len(chain) == 2 and chain[0] == "self":
+            return chain[1]
+    return None
+
+
+class _ModelBuilder:
+    """Extract a :class:`ModuleModel` from one parsed file."""
+
+    def __init__(self, tree: ast.Module, path: str, comments: Dict[int, str]):
+        self.tree = tree
+        self.path = path
+        self.module = ModuleModel(path=path, comments=comments)
+        self.module.func_calls = {}
+        self.comments = comments
+
+    def build(self) -> ModuleModel:
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _lock_ctor_kind(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.module.module_locks.add(tgt.id)
+            if isinstance(node, ast.ClassDef):
+                self._build_class(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_module_func(node)
+        self._find_thread_targets()
+        self._apply_entry_markers()
+        return self.module
+
+    # -- class models ------------------------------------------------------
+
+    def _build_class(self, node: ast.ClassDef) -> None:
+        model = ClassModel(name=node.name, path=self.path, line=node.lineno)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                model.methods[item.name] = item
+        # pass 1: lock attributes (any method may create them)
+        for meth in model.methods.values():
+            for sub in ast.walk(meth):
+                if not (isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call)):
+                    continue
+                kind = _lock_ctor_kind(sub.value)
+                if not kind:
+                    continue
+                for tgt in sub.targets:
+                    chain = _chain(tgt)
+                    if len(chain) == 2 and chain[0] == "self":
+                        attr = chain[1]
+                        if kind == "condition":
+                            shared = _shared_lock_arg(sub.value)
+                            model.locks[attr] = shared if shared else attr
+                        else:
+                            model.locks[attr] = attr
+        # resolve conditions wrapping locks declared after them
+        for attr, canon in list(model.locks.items()):
+            model.locks[attr] = model.locks.get(canon, canon)
+        # pass 2: per-method regions, accesses, calls
+        for name, meth in model.methods.items():
+            guard = self._def_guard(meth, model)
+            if guard:
+                model.guards[name] = guard
+            if name.endswith("_loop"):
+                model.entries.add(name)
+            if self._def_marker(meth, THREAD_ENTRY):
+                model.entries.add(name)
+            held0 = (guard,) if guard else ()
+            self._walk_exec(meth, list(held0), model, name)
+        self.module.classes[node.name] = model
+
+    def _def_marker(self, meth, pattern) -> bool:
+        body_start = meth.body[0].lineno if meth.body else meth.lineno
+        return any(
+            ln in self.comments and pattern.search(self.comments[ln])
+            for ln in range(meth.lineno, body_start + 1)
+        )
+
+    def _def_guard(self, meth, model: ClassModel) -> Optional[str]:
+        body_start = meth.body[0].lineno if meth.body else meth.lineno
+        for ln in range(meth.lineno, body_start + 1):
+            text = self.comments.get(ln, "")
+            m = GUARDED_BY.search(text)
+            if m:
+                attr = m.group(1).split(".")[-1]
+                return f"{model.name}.{model.locks.get(attr, attr)}"
+        return None
+
+    def _resolve_lock(self, expr: ast.AST, model: Optional[ClassModel]) -> Optional[str]:
+        chain = _chain(expr)
+        if not chain:
+            return None
+        if model is not None and chain[0] == "self" and len(chain) >= 2:
+            attr = chain[1]
+            if len(chain) == 2 and attr in model.locks:
+                return model.lock_id(attr)
+            if _is_lockish(chain[-1]):
+                return f"{model.name}.{'.'.join(chain[1:])}"
+            return None
+        if len(chain) == 1:
+            name = chain[0]
+            if name in self.module.module_locks or _is_lockish(name):
+                mod = os.path.splitext(os.path.basename(self.path))[0]
+                return f"{mod}.{name}"
+        elif _is_lockish(chain[-1]):
+            mod = os.path.splitext(os.path.basename(self.path))[0]
+            return f"{mod}.{'.'.join(chain)}"
+        return None
+
+    def _walk_exec(
+        self,
+        node: ast.AST,
+        held: List[str],
+        model: Optional[ClassModel],
+        method: str,
+    ) -> None:
+        """Recursive walk tracking the held-lock stack; records accesses,
+        calls, acquisition edges. Nested def/lambda bodies run later on an
+        unknown thread — they restart with an empty held set."""
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(child, held, model, method)
+
+    def _walk_node(
+        self,
+        child: ast.AST,
+        held: List[str],
+        model: Optional[ClassModel],
+        method: str,
+    ) -> None:
+        """Dispatch one node: with-statements extend the held stack for
+        their body (each body statement dispatched through here again, so
+        nested withs stack their edges)."""
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            self._walk_exec(child, [], model, method)
+            return
+        if isinstance(child, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in child.items:
+                lid = self._resolve_lock(item.context_expr, model)
+                if lid is None:
+                    continue
+                if not self._line_marked(child.lineno, LOCK_ORDER_OK):
+                    edges = (
+                        model.nest_edges if model else self.module.nest_edges
+                    )
+                    for h in inner:
+                        if h != lid:
+                            edges.append((h, lid, child.lineno))
+                if model:
+                    model.direct_acquires.setdefault(method, set()).add(lid)
+                if lid not in inner:
+                    inner.append(lid)
+            for stmt in child.body:
+                self._walk_node(stmt, inner, model, method)
+            return
+        self._record(child, held, model, method)
+        self._walk_exec(child, held, model, method)
+
+    def _line_marked(self, line: int, pattern) -> bool:
+        text = self.comments.get(line, "")
+        return bool(pattern.search(text))
+
+    def _record(
+        self, node: ast.AST, held: List[str], model: Optional[ClassModel], method: str
+    ) -> None:
+        if isinstance(node, ast.Call):
+            sink = model.calls if model else self.module.calls
+            sink.append((node, tuple(held), method))
+            if model is not None:
+                chain = _chain(node.func)
+                if len(chain) == 2 and chain[0] == "self":
+                    model.self_calls.setdefault(method, set()).add(chain[1])
+        if model is None:
+            return
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            if node.value.id != "self":
+                return
+            attr = node.attr
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            site = Site(
+                line=node.lineno,
+                end_line=node.end_lineno or node.lineno,
+                held=tuple(held),
+                method=method,
+                is_write=is_write,
+            )
+            model.accesses.setdefault(attr, []).append(site)
+            if method == "__init__" and is_write and attr not in model.decl_lines:
+                model.decl_lines[attr] = node.lineno
+        if isinstance(node, ast.Subscript):
+            # self.d[k] = v / del self.d[k]: a write to the shared container
+            if (
+                isinstance(node.ctx, (ast.Store, ast.Del))
+                and isinstance(node.value, ast.Attribute)
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id == "self"
+            ):
+                attr = node.value.attr
+                model.accesses.setdefault(attr, []).append(
+                    Site(
+                        line=node.lineno,
+                        end_line=node.end_lineno or node.lineno,
+                        held=tuple(held),
+                        method=method,
+                        is_write=True,
+                    )
+                )
+
+    # -- module-level thread plumbing -------------------------------------
+
+    def _scan_module_func(self, node) -> None:
+        calls: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                calls.add(sub.func.attr)
+        self.module.func_calls[node.name] = calls
+        self._walk_exec(node, [], None, node.name)
+
+    def _find_thread_targets(self) -> None:
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and _final_name(node.func) == "Thread"):
+                continue
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None:
+                continue
+            chain = _chain(target)
+            if len(chain) == 2 and chain[0] == "self":
+                # attribute entry: credit every class defining the method
+                for model in self.module.classes.values():
+                    if chain[1] in model.methods:
+                        model.entries.add(chain[1])
+            elif len(chain) == 1:
+                self.module.thread_funcs.add(chain[0])
+        # a method called from a module-level thread function runs on that
+        # thread (the weakref-trampoline pattern in train/prefetch.py)
+        for fn in self.module.thread_funcs:
+            for callee in self.module.func_calls.get(fn, ()):
+                for model in self.module.classes.values():
+                    if callee in model.methods:
+                        model.entries.add(callee)
+
+    def _apply_entry_markers(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# checks
+
+
+BLOCKING_SOCKET_ATTRS = ("recv", "recv_into", "sendall", "accept", "connect")
+SOCKET_HINTS = ("sock", "conn", "client", "peer", "chan")
+THREAD_HINTS = ("thread", "proc", "worker")
+
+
+def _blocking_what(call: ast.Call) -> Optional[str]:
+    """A human-readable label when ``call`` blocks, else None."""
+    fn = call.func
+    final = _final_name(fn)
+    chain = _chain(fn)
+    hints = [c.lstrip("_").lower() for c in chain[:-1]] if chain else []
+    if final == "sleep":
+        return "sleep()"
+    if final in ("send_frame", "recv_frame"):
+        return f"{final}() frame I/O"
+    if final in BLOCKING_SOCKET_ATTRS and isinstance(fn, ast.Attribute):
+        return f".{final}() socket op"
+    if final == "send" and any(
+        any(h in ident for h in SOCKET_HINTS) for ident in hints
+    ):
+        return ".send() socket op"
+    if final == "join" and any(
+        any(h in ident for h in THREAD_HINTS) or ident in ("t", "th")
+        for ident in hints
+    ):
+        return ".join() on a thread"
+    if final == "request" and any(
+        any(h in ident for h in ("client", "rpc", "cli", "router")) for ident in hints
+    ):
+        return ".request() RPC round-trip"
+    if final in ("block_until_ready", "device_get"):
+        return f"jax.{final}()"
+    return None
+
+
+class Analyzer:
+    """Whole-tree analysis: per-class checks plus the global lock graph."""
+
+    def __init__(self) -> None:
+        self.modules: List[ModuleModel] = []
+        self.violations: List[Violation] = []
+        # lock graph: src -> dst -> (path, line)
+        self.edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        # method name -> [(class model, method)] across all modules
+        self.method_index: Dict[str, List[ClassModel]] = {}
+
+    def add_source(self, source: str, path: str) -> None:
+        tree = ast.parse(source, filename=path)
+        comments = comment_lines(source)
+        module = _ModelBuilder(tree, path, comments).build()
+        self.modules.append(module)
+        for model in module.classes.values():
+            for m in model.methods:
+                self.method_index.setdefault(m, []).append(model)
+
+    # -- suppression helpers ----------------------------------------------
+
+    def _marker(self, module: ModuleModel, lines, pattern) -> Optional[str]:
+        """The marker reason when any of ``lines`` carries ``pattern``;
+        None when absent. An empty reason returns '' (and is a violation
+        at the call sites that require justification)."""
+        if isinstance(lines, int):
+            lines = range(lines, lines + 1)
+        for ln in lines:
+            text = module.comments.get(ln, "")
+            m = pattern.search(text)
+            if m:
+                return _strip_reason(m.group(1)) if m.groups() else ""
+        return None
+
+    def _suppressed(
+        self, module: ModuleModel, lines, pattern, label: str
+    ) -> Optional[bool]:
+        """True → suppressed with reason; False → no marker; emitting a
+        violation (and returning True, site handled) for a reason-less
+        marker."""
+        reason = self._marker(module, lines, pattern)
+        if reason is None:
+            return False
+        if not reason:
+            first = lines if isinstance(lines, int) else lines[0]
+            self.violations.append(
+                Violation(
+                    module.path,
+                    first,
+                    f"'{label}' suppression without a reason — every "
+                    "suppression must name its justification",
+                )
+            )
+        return True
+
+    # -- check 1: unguarded shared state ----------------------------------
+
+    def _check_shared_state(self, module: ModuleModel, model: ClassModel) -> None:
+        if not model.entries:
+            return
+        reachable = model.thread_reachable()
+        class_locks = {model.lock_id(a) for a in model.locks}
+        for attr, sites in sorted(model.accesses.items()):
+            if attr in model.locks:
+                continue
+            thread_writes = [
+                s
+                for s in sites
+                if s.is_write
+                and s.method in reachable
+                and s.method not in CONSTRUCTORS
+            ]
+            outside = [
+                s
+                for s in sites
+                if s.method not in reachable and s.method not in CONSTRUCTORS
+            ]
+            if not thread_writes or not outside:
+                continue
+            decl = model.decl_lines.get(attr)
+            decl_lines = range(decl, decl + 1) if decl else range(0)
+            # attribute-level escape hatches on the __init__ assignment line
+            if self._suppressed(module, list(decl_lines) or 0, RACE_OK, "race: ok") and decl:
+                continue
+            guard = self._marker(module, list(decl_lines) or 0, GUARDED_BY) if decl else None
+            guard_id = None
+            if guard is not None:
+                attr_name = guard.split(".")[-1]
+                if attr_name in model.locks:
+                    guard_id = model.lock_id(attr_name)
+                else:
+                    # external mechanism (queue-internal, GIL, …): trusted
+                    continue
+            required = {guard_id} if guard_id else class_locks
+            for s in sites:
+                if s.method in CONSTRUCTORS:
+                    continue
+                if set(s.held) & required:
+                    continue
+                span = list(range(s.line, s.end_line + 1))
+                if self._suppressed(module, span, RACE_OK, "race: ok"):
+                    continue
+                if self._marker(module, span, GUARDED_BY) is not None:
+                    # site-level assertion: protected by a mechanism the
+                    # analyzer cannot see (trusted, but documented)
+                    continue
+                want = (
+                    f"under {guard_id}" if guard_id else "under the class lock"
+                )
+                kind = "written" if s.is_write else "read"
+                self.violations.append(
+                    Violation(
+                        module.path,
+                        s.line,
+                        f"{model.name}.{attr} {kind} in {s.method}() without "
+                        f"holding a lock, but a thread entry point writes it "
+                        f"— access it {want}, declare '# guarded-by: <lock>', "
+                        "or justify '# race: ok — <reason>'",
+                    )
+                )
+
+    # -- check 2: lock-order graph ----------------------------------------
+
+    def _add_edge(self, src: str, dst: str, path: str, line: int) -> None:
+        if src == dst:
+            return
+        self.edges.setdefault(src, {}).setdefault(dst, (path, line))
+
+    def _collect_edges(self, module: ModuleModel) -> None:
+        for src, dst, line in module.nest_edges:
+            self._add_edge(src, dst, module.path, line)
+        for model in module.classes.values():
+            for src, dst, line in model.nest_edges:
+                self._add_edge(src, dst, module.path, line)
+            for call, held, method in model.calls:
+                if not held:
+                    continue
+                if self._marker(module, call.lineno, LOCK_ORDER_OK) is not None:
+                    continue
+                chain = _chain(call.func)
+                if not chain or not isinstance(call.func, ast.Attribute):
+                    continue
+                callee = chain[-1]
+                hints = [c.lstrip("_").lower() for c in chain[:-1]]
+                if chain[0] == "self" and len(chain) == 2:
+                    targets = [model] if callee in model.methods else []
+                else:
+                    targets = [
+                        other
+                        for other in self.method_index.get(callee, ())
+                        if other is not model
+                        and self._hints_match(hints, other.name)
+                    ]
+                for target in targets:
+                    for lid in target.acquire_closure(callee):
+                        for h in held:
+                            self._add_edge(h, lid, module.path, call.lineno)
+
+    @staticmethod
+    def _hints_match(hints: List[str], class_name: str) -> bool:
+        cls = class_name.lower()
+        for ident in hints:
+            if ident in ("self", "cls") or len(ident) < 3:
+                continue
+            if ident in cls or cls in ident:
+                return True
+        return False
+
+    def _check_cycles(self) -> None:
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(node: str) -> Optional[List[str]]:
+            color[node] = 1
+            stack.append(node)
+            for nxt in sorted(self.edges.get(node, ())):
+                if color.get(nxt, 0) == 1:
+                    return stack[stack.index(nxt):] + [nxt]
+                if color.get(nxt, 0) == 0:
+                    cycle = dfs(nxt)
+                    if cycle:
+                        return cycle
+            stack.pop()
+            color[node] = 2
+            return None
+
+        for node in sorted(self.edges):
+            if color.get(node, 0) == 0:
+                cycle = dfs(node)
+                if cycle:
+                    path, line = self.edges[cycle[0]][cycle[1]]
+                    self.violations.append(
+                        Violation(
+                            path,
+                            line,
+                            "lock-order cycle: " + " -> ".join(cycle) + " — "
+                            "break the inversion or justify the edge with "
+                            "'# lock-order: ok — <reason>'",
+                        )
+                    )
+                    return
+
+    # -- check 3: blocking under lock -------------------------------------
+
+    def _check_blocking(self, module: ModuleModel) -> None:
+        pools = [(None, module.calls)] + [
+            (model, model.calls) for model in module.classes.values()
+        ]
+        for _model, calls in pools:
+            for call, held, _method in calls:
+                if not held:
+                    continue
+                what = _blocking_what(call)
+                if what is None:
+                    continue
+                span = list(range(call.lineno, (call.end_lineno or call.lineno) + 1))
+                if self._suppressed(module, span, BLOCKING_OK, "blocking: ok"):
+                    continue
+                self.violations.append(
+                    Violation(
+                        module.path,
+                        call.lineno,
+                        f"{what} while holding {', '.join(held)} — move the "
+                        "blocking call outside the lock or justify "
+                        "'# blocking: ok — <reason>'",
+                    )
+                )
+
+    # -- required models ---------------------------------------------------
+
+    def _check_required(self) -> None:
+        for suffix, cls, lock in REQUIRED_MODELS:
+            found = False
+            for module in self.modules:
+                if not module.path.endswith(suffix):
+                    continue
+                model = module.classes.get(cls)
+                if (
+                    model is not None
+                    and lock in model.locks
+                    and model.entries
+                ):
+                    found = True
+                break
+            else:
+                continue  # tree does not contain the file: not required
+            if not found:
+                self.violations.append(
+                    Violation(
+                        suffix,
+                        0,
+                        f"required concurrency model missing: {cls} in "
+                        f"{suffix} must keep its {lock!r} lock and a thread "
+                        "entry point — the lock discipline lost its lint "
+                        "protection",
+                    )
+                )
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self, required: bool = True) -> List[Violation]:
+        for module in self.modules:
+            for model in module.classes.values():
+                self._check_shared_state(module, model)
+            self._check_blocking(module)
+            self._collect_edges(module)
+        self._check_cycles()
+        if required:
+            self._check_required()
+        self.violations.sort(key=lambda v: (v.path, v.line))
+        return self.violations
+
+
+def find_violations(source: str, path: str) -> List[Tuple[int, str]]:
+    """Single-source entry (fixture tests): all three checks over one file,
+    without the REQUIRED_MODELS presence check."""
+    analyzer = Analyzer()
+    analyzer.add_source(source, path)
+    return [(v.line, v.what) for v in analyzer.run(required=False)]
+
+
+def check_tree(root: str) -> List[Tuple[str, int, str]]:
+    analyzer = Analyzer()
+    violations: List[Violation] = []
+    for path in iter_py_files(root):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        try:
+            analyzer.add_source(source, path)
+        except SyntaxError as e:
+            violations.append(Violation(path, e.lineno or 0, f"syntax error: {e.msg}"))
+    violations.extend(analyzer.run())
+    return violations
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.join(repo_root(), "maggy_tpu")
+    return report(check_tree(root))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

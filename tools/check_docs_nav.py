@@ -8,7 +8,8 @@ line regex rather than a YAML library so the lint runs on the bare runtime
 image (pyyaml is not vendored).
 
 Usage: ``python tools/check_docs_nav.py [repo_root]`` — exits nonzero
-listing every orphaned page. Wired into the tier-1 run via
+listing every orphaned page. Built on the shared ``tools/analysis``
+framework (docs/static_analysis.md); wired into the tier-1 run via
 ``tests/test_telemetry.py`` alongside ``check_no_bare_print.py``.
 """
 
@@ -17,6 +18,12 @@ from __future__ import annotations
 import os
 import re
 import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import report, repo_root  # noqa: E402
 
 # "  - Title: file.md" (any indent level, quoted or not)
 _NAV_ENTRY = re.compile(r"^\s*-\s+(?:[^:]+:\s*)?['\"]?([\w./-]+\.md)['\"]?\s*$")
@@ -42,10 +49,10 @@ def nav_pages(mkdocs_yml: str):
     return pages
 
 
-def orphaned_docs(repo_root: str):
+def orphaned_docs(repo: str):
     """docs/*.md files absent from the mkdocs nav."""
-    mkdocs_yml = os.path.join(repo_root, "mkdocs.yml")
-    docs_dir = os.path.join(repo_root, "docs")
+    mkdocs_yml = os.path.join(repo, "mkdocs.yml")
+    docs_dir = os.path.join(repo, "docs")
     if not os.path.isfile(mkdocs_yml) or not os.path.isdir(docs_dir):
         return []
     pages = nav_pages(mkdocs_yml)
@@ -58,20 +65,17 @@ def orphaned_docs(repo_root: str):
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    repo = args[0] if args else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
-    )
-    missing = orphaned_docs(repo)
-    for path in missing:
-        print(
-            f"{path}: not referenced from mkdocs.yml nav — add a nav entry "
-            "or the page is unreachable from the docs site",
-            file=sys.stderr,
+    repo = args[0] if args else repo_root()
+    violations = [
+        (
+            path,
+            0,
+            "not referenced from mkdocs.yml nav — add a nav entry or the "
+            "page is unreachable from the docs site",
         )
-    if missing:
-        print(f"{len(missing)} orphaned docs page(s)", file=sys.stderr)
-        return 1
-    return 0
+        for path in orphaned_docs(repo)
+    ]
+    return report(violations)
 
 
 if __name__ == "__main__":

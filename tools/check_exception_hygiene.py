@@ -19,8 +19,10 @@ upstream starves that classification. Two patterns are flagged:
 markers (none today; add sparingly with a reason).
 
 Usage: ``python tools/check_exception_hygiene.py [root]`` — exits nonzero
-listing violations. Wired into the tier-1 run via ``tests/test_resilience.py``,
-beside ``check_no_bare_print.py`` and ``check_docs_nav.py``.
+listing violations. Built on the shared ``tools/analysis`` framework
+(docs/static_analysis.md); wired into the tier-1 run via
+``tests/test_resilience.py``, beside ``check_no_bare_print.py`` and
+``check_docs_nav.py``.
 """
 
 from __future__ import annotations
@@ -28,8 +30,13 @@ from __future__ import annotations
 import ast
 import os
 import sys
-import tokenize
 from typing import List, Set, Tuple
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import comment_lines, report, repo_root, walk_sources  # noqa: E402
 
 # file basenames exempt from the whole check, with a reason each
 ALLOWLIST: Set[str] = set()
@@ -45,22 +52,10 @@ def _is_broad(type_node) -> bool:
     return False
 
 
-def _comment_lines(source: str) -> Set[int]:
-    """Line numbers carrying a comment (the justification-marker seam)."""
-    out: Set[int] = set()
-    try:
-        for tok in tokenize.generate_tokens(iter(source.splitlines(True)).__next__):
-            if tok.type == tokenize.COMMENT:
-                out.add(tok.start[0])
-    except tokenize.TokenError:
-        pass
-    return out
-
-
 def find_violations(source: str, path: str) -> List[Tuple[int, str]]:
     """(line, description) for every unhygienic handler in ``source``."""
     tree = ast.parse(source, filename=path)
-    comments = _comment_lines(source)
+    comments = comment_lines(source)
     out: List[Tuple[int, str]] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.ExceptHandler):
@@ -87,38 +82,17 @@ def find_violations(source: str, path: str) -> List[Tuple[int, str]]:
 
 
 def check_tree(root: str) -> List[Tuple[str, int, str]]:
-    violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))]
-        for name in sorted(filenames):
-            if not name.endswith(".py") or name in ALLOWLIST:
-                continue
-            path = os.path.join(dirpath, name)
-            try:
-                with open(path, encoding="utf-8") as f:
-                    source = f.read()
-            except OSError:
-                continue
-            try:
-                hits = find_violations(source, path)
-            except SyntaxError as e:
-                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
-                continue
-            violations.extend((path, line, what) for line, what in hits)
-    return violations
+    return walk_sources(
+        root,
+        find_violations,
+        skip=lambda path: os.path.basename(path) in ALLOWLIST,
+    )
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    root = args[0] if args else os.path.join(repo, "maggy_tpu")
-    violations = check_tree(root)
-    for path, line, what in violations:
-        print(f"{path}:{line}: {what}", file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} violation(s)", file=sys.stderr)
-        return 1
-    return 0
+    root = args[0] if args else os.path.join(repo_root(), "maggy_tpu")
+    return report(check_tree(root))
 
 
 if __name__ == "__main__":

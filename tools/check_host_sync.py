@@ -25,8 +25,10 @@ all, and the annotation cost of a justified ``# sync: ok`` is one comment.
 (and with it the protection) is itself a violation.
 
 Usage: ``python tools/check_host_sync.py [root]`` — exits nonzero listing
-violations. Wired into the tier-1 run via ``tests/test_prefetch.py``,
-beside the exception-hygiene, bare-print, and docs-nav lints.
+violations. Built on the shared ``tools/analysis`` framework
+(docs/static_analysis.md); wired into the tier-1 run via
+``tests/test_prefetch.py``, beside the exception-hygiene, bare-print, and
+docs-nav lints.
 """
 
 from __future__ import annotations
@@ -35,8 +37,13 @@ import ast
 import os
 import re
 import sys
-import tokenize
-from typing import Dict, List, Set, Tuple
+from typing import List, Tuple
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import marker_lines, report, repo_root, walk_sources  # noqa: E402
 
 HOT_MARKER = re.compile(r"#\s*hot-loop")
 OK_MARKER = re.compile(r"#\s*sync:\s*ok")
@@ -46,18 +53,6 @@ REQUIRED_REGIONS: Tuple[Tuple[str, str], ...] = (
     (os.path.join("maggy_tpu", "train", "trainer.py"), "fit"),
     (os.path.join("maggy_tpu", "serve", "engine.py"), "step"),
 )
-
-
-def _comment_lines(source: str) -> Dict[int, str]:
-    """line -> comment text, tolerating partial tokenization."""
-    out: Dict[int, str] = {}
-    try:
-        for tok in tokenize.generate_tokens(iter(source.splitlines(True)).__next__):
-            if tok.type == tokenize.COMMENT:
-                out[tok.start[0]] = tok.string
-    except tokenize.TokenError:
-        pass
-    return out
 
 
 def _sync_call(node: ast.Call) -> str:
@@ -79,13 +74,8 @@ def _sync_call(node: ast.Call) -> str:
 def find_violations(source: str, path: str) -> List[Tuple[int, str]]:
     """(line, description) for every unjustified sync in a hot region."""
     tree = ast.parse(source, filename=path)
-    comments = _comment_lines(source)
-    hot_lines: Set[int] = {
-        ln for ln, text in comments.items() if HOT_MARKER.search(text)
-    }
-    ok_lines: Set[int] = {
-        ln for ln, text in comments.items() if OK_MARKER.search(text)
-    }
+    hot_lines = marker_lines(source, HOT_MARKER)
+    ok_lines = marker_lines(source, OK_MARKER)
     regions: List[Tuple[int, int]] = []
     for node in ast.walk(tree):
         if isinstance(
@@ -119,8 +109,7 @@ def find_violations(source: str, path: str) -> List[Tuple[int, str]]:
 def has_hot_region(source: str, path: str, func_name: str) -> bool:
     """True when ``func_name`` in ``source`` contains a hot-loop marker."""
     tree = ast.parse(source, filename=path)
-    comments = _comment_lines(source)
-    hot_lines = {ln for ln, text in comments.items() if HOT_MARKER.search(text)}
+    hot_lines = marker_lines(source, HOT_MARKER)
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == func_name:
             if any(node.lineno <= ln <= (node.end_lineno or node.lineno) for ln in hot_lines):
@@ -128,51 +117,28 @@ def has_hot_region(source: str, path: str, func_name: str) -> bool:
     return False
 
 
+def _check_file(source: str, path: str) -> List[Tuple[int, str]]:
+    hits = find_violations(source, path)
+    for suffix, func in REQUIRED_REGIONS:
+        if path.endswith(suffix) and not has_hot_region(source, path, func):
+            hits.append(
+                (
+                    0,
+                    f"required hot-loop marker missing from {func}() — "
+                    "the overlap hot path lost its lint protection",
+                )
+            )
+    return hits
+
+
 def check_tree(root: str) -> List[Tuple[str, int, str]]:
-    violations: List[Tuple[str, int, str]] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [
-            d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))
-        ]
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            try:
-                with open(path, encoding="utf-8") as f:
-                    source = f.read()
-            except OSError:
-                continue
-            try:
-                hits = find_violations(source, path)
-            except SyntaxError as e:
-                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
-                continue
-            violations.extend((path, line, what) for line, what in hits)
-            for suffix, func in REQUIRED_REGIONS:
-                if path.endswith(suffix) and not has_hot_region(source, path, func):
-                    violations.append(
-                        (
-                            path,
-                            0,
-                            f"required hot-loop marker missing from {func}() — "
-                            "the overlap hot path lost its lint protection",
-                        )
-                    )
-    return violations
+    return walk_sources(root, _check_file)
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    root = args[0] if args else os.path.join(repo, "maggy_tpu")
-    violations = check_tree(root)
-    for path, line, what in violations:
-        print(f"{path}:{line}: {what}", file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} violation(s)", file=sys.stderr)
-        return 1
-    return 0
+    root = args[0] if args else os.path.join(repo_root(), "maggy_tpu")
+    return report(check_tree(root))
 
 
 if __name__ == "__main__":

@@ -23,7 +23,8 @@ safe-live contract.
     ``autopilot.*`` prefix does not match the knob scopes.)
 
 Usage: ``python tools/check_knob_registry.py [root]`` — exits nonzero
-listing violations. Wired into the tier-1 run via
+listing violations. Built on the shared ``tools/analysis`` framework
+(docs/static_analysis.md); wired into the tier-1 run via
 ``tests/test_autopilot.py``, beside the telemetry-name, host-sync,
 exception-hygiene, bare-print, and docs-nav lints.
 """
@@ -31,24 +32,31 @@ exception-hygiene, bare-print, and docs-nav lints.
 from __future__ import annotations
 
 import ast
-import importlib.util
 import os
 import re
 import sys
 from typing import List, Tuple
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import (  # noqa: E402
+    load_module_from_path,
+    report,
+    repo_root,
+    walk_sources,
+)
 
 KNOB_PATTERN = re.compile(r"^(train|serve|fleet)\.[a-z][a-z0-9_]*$")
 
 
 def load_registry(repo: str):
     """Load knobs.py by path (no package import — it must stay stdlib-only)."""
-    path = os.path.join(repo, "maggy_tpu", "autopilot", "knobs.py")
-    spec = importlib.util.spec_from_file_location("maggy_tpu_knob_registry", path)
-    mod = importlib.util.module_from_spec(spec)
-    # dataclass processing resolves the defining module through sys.modules
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod
+    return load_module_from_path(
+        "maggy_tpu_knob_registry",
+        os.path.join(repo, "maggy_tpu", "autopilot", "knobs.py"),
+    )
 
 
 def _literal(node) -> str:
@@ -110,35 +118,18 @@ def check_source(
 
 
 def check_tree(root: str, registry) -> List[Tuple[str, int, str]]:
-    violations: List[Tuple[str, int, str]] = []
     ap_pkg = os.path.join("maggy_tpu", "autopilot")
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [
-            d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))
-        ]
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            try:
-                with open(path, encoding="utf-8") as f:
-                    source = f.read()
-            except OSError:
-                continue
-            try:
-                hits = check_source(
-                    source, path, registry, in_autopilot_pkg=ap_pkg in path
-                )
-            except SyntaxError as e:
-                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
-                continue
-            violations.extend((path, line, what) for line, what in hits)
-    return violations
+    return walk_sources(
+        root,
+        lambda source, path: check_source(
+            source, path, registry, in_autopilot_pkg=ap_pkg in path
+        ),
+    )
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = repo_root()
     root = args[0] if args else os.path.join(repo, "maggy_tpu")
     registry = load_registry(repo)
     violations = [
@@ -146,12 +137,7 @@ def main(argv=None) -> int:
         for err in registry.validate_registry()
     ]
     violations.extend(check_tree(root, registry))
-    for path, line, what in violations:
-        print(f"{path}:{line}: {what}", file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} violation(s)", file=sys.stderr)
-        return 1
-    return 0
+    return report(violations)
 
 
 if __name__ == "__main__":

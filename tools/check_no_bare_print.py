@@ -12,7 +12,9 @@ Allowlisted files: ``reporter.py`` (owns the print tee itself) and
 ``monitor.py`` (a CLI whose stdout IS the product).
 
 Usage: ``python tools/check_no_bare_print.py [root]`` — exits nonzero listing
-violations. Wired into the tier-1 run via ``tests/test_telemetry.py``.
+violations. Built on the shared ``tools/analysis`` framework
+(docs/static_analysis.md); wired into the tier-1 run via
+``tests/test_telemetry.py``.
 """
 
 from __future__ import annotations
@@ -20,6 +22,12 @@ from __future__ import annotations
 import ast
 import os
 import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import report, repo_root, walk_sources  # noqa: E402
 
 ALLOWED_FILES = {"reporter.py", "monitor.py"}
 
@@ -39,43 +47,29 @@ def find_bare_prints(source: str, path: str):
     return out
 
 
+def _check_file(source: str, path: str):
+    return [
+        (
+            line,
+            "bare print() — route through Reporter/Telemetry or pass an "
+            "explicit file=",
+        )
+        for line, _ in find_bare_prints(source, path)
+    ]
+
+
 def check_tree(root: str):
-    violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if not d.startswith((".", "_build"))]
-        for name in sorted(filenames):
-            if not name.endswith(".py") or name in ALLOWED_FILES:
-                continue
-            path = os.path.join(dirpath, name)
-            try:
-                with open(path, encoding="utf-8") as f:
-                    source = f.read()
-            except OSError:
-                continue
-            try:
-                hits = find_bare_prints(source, path)
-            except SyntaxError as e:
-                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
-                continue
-            violations.extend((path, line, "bare print()") for line, _ in hits)
-    return violations
+    return walk_sources(
+        root,
+        _check_file,
+        skip=lambda path: os.path.basename(path) in ALLOWED_FILES,
+    )
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    root = args[0] if args else os.path.join(repo, "maggy_tpu")
-    violations = check_tree(root)
-    for path, line, what in violations:
-        print(
-            f"{path}:{line}: {what} — route through Reporter/Telemetry or "
-            "pass an explicit file=",
-            file=sys.stderr,
-        )
-    if violations:
-        print(f"{len(violations)} violation(s)", file=sys.stderr)
-        return 1
-    return 0
+    root = args[0] if args else os.path.join(repo_root(), "maggy_tpu")
+    return report(check_tree(root))
 
 
 if __name__ == "__main__":

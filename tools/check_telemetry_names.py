@@ -29,39 +29,47 @@ data. This lint makes the name set closed:
   transition event — a typo'd rule name must not mint a phantom alert.
 
 Usage: ``python tools/check_telemetry_names.py [root]`` — exits nonzero
-listing violations. Wired into the tier-1 run via ``tests/test_tracing.py``,
-beside the host-sync, exception-hygiene, bare-print, and docs-nav lints.
+listing violations. Built on the shared ``tools/analysis`` framework
+(docs/static_analysis.md); wired into the tier-1 run via
+``tests/test_tracing.py``, beside the host-sync, exception-hygiene,
+bare-print, and docs-nav lints.
 """
 
 from __future__ import annotations
 
 import ast
-import importlib.util
 import os
 import sys
 from typing import List, Tuple
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis import (  # noqa: E402
+    load_module_from_path,
+    report,
+    repo_root,
+    walk_sources,
+)
 
 TELEMETRY_METHODS = ("gauge", "count", "histogram", "event")
 
 
 def load_registry(repo: str):
     """Load metrics.py by path (no package import — it must stay stdlib-only)."""
-    path = os.path.join(repo, "maggy_tpu", "telemetry", "metrics.py")
-    spec = importlib.util.spec_from_file_location("maggy_tpu_metrics_registry", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return load_module_from_path(
+        "maggy_tpu_metrics_registry",
+        os.path.join(repo, "maggy_tpu", "telemetry", "metrics.py"),
+    )
 
 
 def load_alerts(repo: str):
     """Load the alert-rule registry by path (stdlib-only, like metrics.py)."""
-    path = os.path.join(repo, "maggy_tpu", "telemetry", "alerts.py")
-    spec = importlib.util.spec_from_file_location("maggy_tpu_alerts_registry", path)
-    mod = importlib.util.module_from_spec(spec)
-    # dataclasses resolves field types through sys.modules[cls.__module__]
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod
+    return load_module_from_path(
+        "maggy_tpu_alerts_registry",
+        os.path.join(repo, "maggy_tpu", "telemetry", "alerts.py"),
+    )
 
 
 def check_units(registry) -> List[str]:
@@ -200,32 +208,14 @@ def check_source(source: str, path: str, registry, alert_names=None) -> List[Tup
 
 
 def check_tree(root: str, registry, alert_names=None) -> List[Tuple[str, int, str]]:
-    violations: List[Tuple[str, int, str]] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [
-            d for d in dirnames if not d.startswith((".", "_build", "__pycache__"))
-        ]
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            try:
-                with open(path, encoding="utf-8") as f:
-                    source = f.read()
-            except OSError:
-                continue
-            try:
-                hits = check_source(source, path, registry, alert_names)
-            except SyntaxError as e:
-                violations.append((path, e.lineno or 0, f"syntax error: {e.msg}"))
-                continue
-            violations.extend((path, line, what) for line, what in hits)
-    return violations
+    return walk_sources(
+        root, lambda source, path: check_source(source, path, registry, alert_names)
+    )
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = repo_root()
     root = args[0] if args else os.path.join(repo, "maggy_tpu")
     registry = load_registry(repo)
     alerts = load_alerts(repo)
@@ -241,12 +231,7 @@ def main(argv=None) -> int:
         alerts.ALERT_RESOLVED,
     }
     violations.extend(check_tree(root, registry, alert_names))
-    for path, line, what in violations:
-        print(f"{path}:{line}: {what}", file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} violation(s)", file=sys.stderr)
-        return 1
-    return 0
+    return report(violations)
 
 
 if __name__ == "__main__":
