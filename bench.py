@@ -851,6 +851,118 @@ def bench_fleet(quick: bool = False):
     }
 
 
+def bench_qos(quick: bool = False):
+    """extra.qos: overload-robustness gate (ISSUE 15). A seeded 2-class
+    replay (premium trickle + best-effort flood) is driven through the
+    fleet twice: unloaded (premium only, trickle rate) and overloaded
+    (flood at ~2x capacity). Reports per-class TTFT p50/p95, shed and
+    preemption counts, and the no-cliff bit: premium's overloaded TTFT p95
+    must stay within 1.5x its unloaded p95 — QoS admission + priority
+    preemption + the brownout ladder are what hold that line while
+    best-effort degrades. CPU-safe (tiny decoder, in-process replicas)."""
+    import jax
+    import jax.numpy as jnp
+
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.parallel.sharding import unbox
+    from maggy_tpu.serve import ServeClient, TenantMix, TrafficReplay, TrafficSpec
+    from maggy_tpu.serve.fleet import ReplicaSpec, RouterConfig, launch_fleet
+    from maggy_tpu.serve.loadgen import generate as gen_schedule
+    from maggy_tpu.serve.loadgen import summarize
+    from maggy_tpu.serve.qos import BEST_EFFORT, PREMIUM, STANDARD
+
+    cfg = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+    params = unbox(
+        Decoder(cfg).init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    duration_s = 3.0 if quick else 6.0
+    premium_mix = TenantMix(
+        "acme", qos=PREMIUM, weight=1.0, prompt_len=14, prefix_len=14,
+        n_prefixes=3, max_new=6,
+    )
+
+    def run(flood: bool):
+        router = launch_fleet(
+            ReplicaSpec(cfg, params, num_slots=3, paged=True, num_pages=6),
+            replicas=2,
+            config=RouterConfig(
+                slo_ttft_ms=1000.0,
+                admission="queue",
+                brownout_escalate_s=0.3,
+                brownout_recover_s=1.0,
+            ),
+        )
+        host, port = router.start(host="127.0.0.1")
+        tenants = (premium_mix,)
+        base_rps = 4.0
+        if flood:
+            tenants = (
+                premium_mix,
+                TenantMix("bulk", qos=BEST_EFFORT, weight=11.0,
+                          prompt_len=14, max_new=16),
+            )
+            base_rps = 30.0 if quick else 60.0
+        spec = TrafficSpec(
+            seed=11, duration_s=duration_s, base_rps=base_rps, tenants=tenants
+        )
+        try:
+            with ServeClient((host, port), router.secret) as client:
+                # warm every storm shape on both replicas (fresh prefill,
+                # resume-prefill bucket, batched decode) so first-use
+                # compiles never masquerade as overload latency
+                for i in range(4):
+                    client.generate(list(range(1 + i, 15 + i)), max_new=2,
+                                    qos=STANDARD, timeout=240)
+                warm = [
+                    client.submit(list(range(2 + i, 26 + i)), max_new=4,
+                                  qos=STANDARD)
+                    for i in range(8)
+                ]
+                for rid in warm:
+                    client.result(rid, timeout=240)
+                deadline = time.time() + 60
+                while time.time() < deadline and (
+                    router.brownout.level() != 0 or router.alerts.firing()
+                ):
+                    time.sleep(0.2)
+                outcomes = TrafficReplay(
+                    client, gen_schedule(spec), result_timeout_s=25.0
+                ).run(timeout=120.0)
+                stats = client.stats()
+            preempted = sum(
+                r.server.scheduler.preemptions
+                for r in router.replicas
+                if r.server is not None
+            )
+        finally:
+            router.stop()
+        by_class = summarize(outcomes)
+        return by_class, stats, preempted
+
+    unloaded, _, _ = run(flood=False)
+    overload, stats, preempted = run(flood=True)
+    prem_base = (unloaded.get(PREMIUM) or {}).get("ttft_p95_ms")
+    prem_over = (overload.get(PREMIUM) or {}).get("ttft_p95_ms")
+    no_cliff = (
+        prem_base is not None
+        and prem_over is not None
+        and prem_over <= 1.5 * prem_base
+    )
+    return {
+        "duration_s": duration_s,
+        "premium_ttft_p95_unloaded_ms": prem_base,
+        "premium_ttft_p95_overload_ms": prem_over,
+        "unloaded": unloaded,
+        "overload": overload,
+        "shed": stats["routing"]["shed"],
+        "preempted": preempted,
+        "brownout_peak": max(
+            [lvl for _, lvl in stats["brownout"]["history"]], default=0
+        ),
+        "no_cliff": bool(no_cliff),
+    }
+
+
 def bench_autotune(quick: bool = False):
     """Autotune provenance (maggy_tpu/tune): run the static AOT stage over a
     small mesh/batch grid for the tiny decoder and record what the tuner
@@ -1260,6 +1372,7 @@ def write_run_summary(out) -> str:
         ("timeseries", "within_budget"),
         ("paging", "within_budget"),
         ("overlap", "within_budget"),
+        ("qos", "no_cliff"),
     ):
         bit = _get(block, key)
         if bit is not None:
@@ -1302,6 +1415,7 @@ def main():
         input_pipeline_stats = None
         serve_drain_stats = None
         fleet_stats = None
+        qos_stats = None
         trace_overhead_stats = None
         autopilot_stats = None
         elastic_stats = None
@@ -1334,6 +1448,10 @@ def main():
             fleet_stats = bench_fleet(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
             fleet_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            qos_stats = bench_qos(quick=args.quick)
+        except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
+            qos_stats = {"error": f"{type(e).__name__}: {e}"}
         try:
             trace_overhead_stats = bench_trace_overhead(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
@@ -1384,6 +1502,7 @@ def main():
             "input_pipeline": input_pipeline_stats,
             "serve_drain": serve_drain_stats,
             "fleet": fleet_stats,
+            "qos": qos_stats,
             "trace_overhead": trace_overhead_stats,
             "autopilot": autopilot_stats,
             "elastic": elastic_stats,

@@ -24,6 +24,13 @@ Seams (all zero-cost when no plan is installed):
   replica's RPC port closes and its scheduler is abandoned mid-decode,
   simulating a preempted serving host (the router must requeue its
   in-flight requests to survivors; docs/fleet.md).
+* The serve scheduler consults ``replica_slow`` per admission — a gray
+  (slow-but-alive) replica whose own TTFT telemetry absorbs the injected
+  latency, which is what the router's circuit breaker scores
+  (docs/resilience.md "Gray failure & circuit breakers").
+* The traffic generator (``serve/loadgen.py``) consults ``tenant_burst``
+  while building a schedule — one tenant's offered load is multiplied,
+  driving the brownout ladder without a bespoke traffic spec.
 * ``Trainer.fit`` consults ``slice_drop`` / ``slice_rejoin`` each step when
   running under an elastic membership monitor — a matching ``slice_drop``
   raises :class:`~maggy_tpu.resilience.membership.SliceLost` (the slice's
@@ -69,6 +76,8 @@ KINDS = frozenset(
         "replica_kill",  # kill a serving fleet replica mid-stream
         "slice_drop",  # a slice leaves the elastic data mesh at step K
         "slice_rejoin",  # a dropped slice comes back at step K
+        "replica_slow",  # gray failure: delay replica N's admissions by ms=K
+        "tenant_burst",  # multiply tenant T's offered load by mult=M (loadgen)
     }
 )
 
@@ -116,6 +125,13 @@ class Chaos:
                 if key == "times":
                     times = int(value)
                 elif key == "secs":
+                    arg = float(value)
+                elif key == "ms":
+                    # latency payloads (replica_slow) are spelled in ms on
+                    # the wire but carried in seconds like secs
+                    arg = float(value) / 1e3
+                elif key == "mult":
+                    # rate-multiplier payload (tenant_burst)
                     arg = float(value)
                 else:
                     match[key.strip()] = value.strip()
@@ -168,6 +184,26 @@ class Chaos:
         router's pump consults it only while the replica is mid-stream, so
         a matching rule always exercises requeue-to-survivors)."""
         return self.fire("replica_kill", replica=replica) is not None
+
+    def replica_slow(self, replica: Any) -> float:
+        """Seconds of gray-failure latency to inject into this replica's
+        next admission (0.0 = none). The scheduler consults it per admitted
+        request, so the slow replica's own TTFT histograms absorb the
+        delay — exactly the signal the router's circuit breaker scores
+        (docs/resilience.md "Gray failure"). Spell sustained slowness with
+        ``times=N``: ``replica_slow:replica=1,ms=300,times=50``."""
+        fault = self.fire("replica_slow", replica=replica)
+        return fault.arg if fault is not None else 0.0
+
+    def tenant_burst(self, tenant: Any) -> float:
+        """Offered-load multiplier for this tenant (1.0 = no burst). The
+        traffic generator consults it while building a schedule, so a burst
+        scenario is spelled as chaos instead of a bespoke spec:
+        ``tenant_burst:tenant=bulk,mult=5``."""
+        fault = self.fire("tenant_burst", tenant=tenant)
+        if fault is None or fault.arg <= 0:
+            return 1.0
+        return fault.arg
 
     def slice_drop(self, slices, step: Optional[int] = None) -> Optional[Any]:
         """The id of the ACTIVE slice a ``slice_drop`` rule kills at this

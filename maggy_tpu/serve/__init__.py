@@ -22,27 +22,53 @@ router over N of these stacks behind the same verb set
 
 from maggy_tpu.serve.client import ServeClient  # noqa: F401
 from maggy_tpu.serve.engine import Engine  # noqa: F401
+from maggy_tpu.serve.loadgen import (  # noqa: F401
+    Arrival,
+    Burst,
+    TenantMix,
+    TrafficReplay,
+    TrafficSpec,
+)
 from maggy_tpu.serve.paging import (  # noqa: F401
     BlockAllocator,
     OutOfPagesError,
     PageTable,
 )
 from maggy_tpu.serve.prefix import PrefixIndex  # noqa: F401
+from maggy_tpu.serve.qos import (  # noqa: F401
+    BEST_EFFORT,
+    PREMIUM,
+    QOS_CLASSES,
+    STANDARD,
+    QosQueue,
+    QuotaLedger,
+)
 from maggy_tpu.serve.request import Request, SamplingParams  # noqa: F401
 from maggy_tpu.serve.scheduler import Scheduler  # noqa: F401
 from maggy_tpu.serve.server import ServeServer  # noqa: F401
 from maggy_tpu.serve.slots import SlotManager  # noqa: F401
 
 __all__ = [
+    "Arrival",
+    "BEST_EFFORT",
     "BlockAllocator",
+    "Burst",
     "Engine",
     "OutOfPagesError",
+    "PREMIUM",
     "PageTable",
     "PrefixIndex",
+    "QOS_CLASSES",
+    "QosQueue",
+    "QuotaLedger",
+    "STANDARD",
     "Scheduler",
     "ServeServer",
     "ServeClient",
     "SlotManager",
     "Request",
     "SamplingParams",
+    "TenantMix",
+    "TrafficReplay",
+    "TrafficSpec",
 ]

@@ -79,7 +79,14 @@ class ServeClient:
                         f"(projected_ttft_ms={reply.get('projected_ttft_ms')})"
                     )
                 busy_left -= 1
-                time.sleep(float(reply.get("retry_after_s") or 0.25))
+                # prefer the server's projected-drain hint (retry_after_ms,
+                # staggered per shed so clients don't re-arrive in lockstep)
+                hint_ms = reply.get("retry_after_ms")
+                if hint_ms is not None:
+                    delay = float(hint_ms) / 1e3
+                else:
+                    delay = float(reply.get("retry_after_s") or 0.25)
+                time.sleep(delay)
                 continue  # BUSY retries don't consume transport attempts
             return reply
         raise RpcError(
@@ -98,26 +105,32 @@ class ServeClient:
         deadline_s: Optional[float] = None,
         retry_busy: int = 0,
         trace: Optional[str] = None,
+        tenant: Optional[str] = None,
+        qos: Optional[str] = None,
     ) -> str:
         """Submit one request. A request-scoped ``trace`` id is minted here
         (or adopted from the caller / ambient scope) and rides the SUBMIT
         frame — the server stamps every lifecycle event with it, so the
         request's whole cross-worker journey correlates in the exported
-        trace (docs/observability.md). Retried submits reuse the same id."""
-        reply = self._call(
-            {
-                "type": "SUBMIT",
-                "prompt": [int(t) for t in prompt],
-                "temperature": temperature,
-                "top_k": top_k,
-                "max_new": max_new,
-                "eos_id": eos_id,
-                "seed": seed,
-                "deadline_s": deadline_s,
-                "trace": trace or tracing.ensure(),
-            },
-            retry_busy=retry_busy,
-        )
+        trace (docs/observability.md). Retried submits reuse the same id.
+        ``tenant``/``qos`` select the QoS class (docs/fleet.md "QoS
+        classes"); omitted means best_effort under the anonymous tenant."""
+        msg = {
+            "type": "SUBMIT",
+            "prompt": [int(t) for t in prompt],
+            "temperature": temperature,
+            "top_k": top_k,
+            "max_new": max_new,
+            "eos_id": eos_id,
+            "seed": seed,
+            "deadline_s": deadline_s,
+            "trace": trace or tracing.ensure(),
+        }
+        if tenant is not None:
+            msg["tenant"] = str(tenant)
+        if qos is not None:
+            msg["qos"] = str(qos)
+        reply = self._call(msg, retry_busy=retry_busy)
         return reply["id"]
 
     def poll(self, request_id: str) -> Dict[str, Any]:

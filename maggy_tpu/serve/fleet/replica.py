@@ -32,6 +32,185 @@ STARTING = "starting"
 UP = "up"
 DEAD = "dead"
 
+# circuit-breaker states (docs/resilience.md "Gray failure & circuit
+# breakers"): CLOSED dispatches normally; OPEN excludes the replica from
+# dispatch; HALF_OPEN lets bounded probation probes through, whose observed
+# TTFT closes the breaker or re-opens it
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Per-replica gray-failure breaker over latency-outlier scores.
+
+    Health probes catch *dead* replicas; a gray replica answers every probe
+    while serving tokens 10x slower than its peers. The router's metrics
+    tick scores each replica's windowed TTFT p95 against the best healthy
+    peer (:meth:`score`); consecutive outlier scores open the breaker,
+    which removes the replica from dispatch without touching its liveness
+    state. After ``cooldown_s`` the breaker half-opens: one probation probe
+    at a time is dispatched, and the probe's observed TTFT
+    (:meth:`observe_ttft`) either closes the breaker or re-opens it.
+
+    Scored from the router's pump thread and read on dispatch; the lock
+    keeps the state machine's compound transitions atomic (pinned in
+    ``tools/check_concurrency.py`` REQUIRED_MODELS).
+    """
+
+    def __init__(self, index: int, trips: int = 2, cooldown_s: float = 5.0):
+        self.index = index
+        self.trips = int(trips)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = lockdebug.lock("replica.breaker")
+        self._state = BREAKER_CLOSED  # guarded-by: _lock
+        self._slow_streak = 0  # guarded-by: _lock
+        self._opened_ts: Optional[float] = None  # guarded-by: _lock
+        # TTFT a probation probe must beat to close (set when opening,
+        # from the peer baseline that tripped us)  # guarded-by: _lock
+        self._close_below_ms: float = 0.0
+        self._probe_inflight = False  # guarded-by: _lock
+        # rid of the probation dispatch: the verdict must come from the
+        # probe itself, not an old slow stream polled during probation
+        self._probe_rid: Optional[str] = None  # guarded-by: _lock
+        self.opened_total = 0  # guarded-by: _lock
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def score(  # thread-entry — router pump's ~1 Hz metrics tick
+        self,
+        p95_ms: Optional[float],
+        peer_p95_ms: Optional[float],
+        ratio: float,
+        min_ms: float,
+        now: float,
+    ) -> Optional[str]:
+        """Feed one windowed latency score; returns ``"opened"`` on the
+        CLOSED→OPEN transition, else None. A score is an outlier when this
+        replica's TTFT p95 exceeds ``ratio`` x the best healthy peer's AND
+        the absolute floor ``min_ms`` (so microsecond jitter between idle
+        replicas never trips anything)."""
+        slow = (
+            p95_ms is not None
+            and peer_p95_ms is not None
+            and p95_ms >= min_ms
+            and p95_ms > ratio * peer_p95_ms
+        )
+        with self._lock:
+            if self._state != BREAKER_CLOSED:
+                # open/half-open windows go stale (no fresh dispatches);
+                # recovery is probe-driven, not score-driven
+                return None
+            self._slow_streak = self._slow_streak + 1 if slow else 0
+            if self._slow_streak < self.trips:
+                return None
+            self._state = BREAKER_OPEN
+            self._opened_ts = now
+            self._slow_streak = 0
+            self._probe_inflight = False
+            self.opened_total += 1
+            # a recovered replica should look like its peers did when we
+            # tripped — with slack so marginal recovery still closes
+            self._close_below_ms = max(min_ms, ratio * (peer_p95_ms or 0.0))
+            return "opened"
+
+    def ok(self, now: float) -> bool:  # thread-entry — router pump dispatch filter
+        """May the router dispatch to this replica right now? Also drives
+        the timed OPEN→HALF_OPEN transition."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN:
+                if self._opened_ts is not None and (
+                    now - self._opened_ts >= self.cooldown_s
+                ):
+                    self._state = BREAKER_HALF_OPEN
+                    self._probe_inflight = False
+                else:
+                    return False
+            # HALF_OPEN: one probation probe at a time
+            return not self._probe_inflight
+
+    def take_probe(self, rid: str) -> bool:
+        """Claim the half-open probation slot for dispatch ``rid`` (the
+        router calls this only after ``ok()``; CLOSED needs no claim)."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state != BREAKER_HALF_OPEN or self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            self._probe_rid = rid
+            return True
+
+    def observe_ttft(self, rid: str, ttft_ms: float, now: float) -> Optional[str]:  # thread-entry — router pump's poll loop
+        """Feed an observed dispatch TTFT. Only the probation probe's own
+        rid renders a HALF_OPEN verdict: fast closes the breaker, slow
+        re-opens it (and restarts the cooldown). Returns ``"closed"`` /
+        ``"reopened"`` on a transition, else None."""
+        with self._lock:
+            if self._state != BREAKER_HALF_OPEN or rid != self._probe_rid:
+                return None
+            self._probe_inflight = False
+            self._probe_rid = None
+            if ttft_ms <= self._close_below_ms:
+                self._state = BREAKER_CLOSED
+                self._opened_ts = None
+                self._slow_streak = 0
+                return "closed"
+            self._state = BREAKER_OPEN
+            self._opened_ts = now
+            return "reopened"
+
+    def probe_lost(self, rid: Optional[str] = None) -> None:
+        """The probation dispatch died without a TTFT (replica went down,
+        RPC failed): free the probe slot so probation can retry. With a
+        rid, only that probe's claim is released."""
+        with self._lock:
+            if self._state != BREAKER_HALF_OPEN:
+                return
+            if rid is None or rid == self._probe_rid:
+                self._probe_inflight = False
+                self._probe_rid = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "opened_total": self.opened_total,
+                "close_below_ms": round(self._close_below_ms, 3),
+            }
+
+
+class RetryBudget:
+    """Token bucket bounding how many requeues a replica's failures may
+    inject back into the dispatch queue per window — a requeue storm from a
+    flapping replica must not amplify an overload (docs/resilience.md).
+    When the bucket is dry the requeue still happens, but deferred
+    (``RouteEntry.not_before_ts``), never dropped."""
+
+    def __init__(self, capacity: int = 8, window_s: float = 10.0):
+        self.capacity = max(1, int(capacity))
+        self.window_s = float(window_s)
+        self._lock = lockdebug.lock("replica.retry_budget")
+        self._tokens = float(self.capacity)  # guarded-by: _lock
+        self._last_ts: Optional[float] = None  # guarded-by: _lock
+
+    def consume(self, now: float) -> bool:  # thread-entry — pump requeue paths
+        """Take one token; False means the caller should defer its requeue."""
+        with self._lock:
+            if self._last_ts is not None:
+                refill = (now - self._last_ts) * self.capacity / self.window_s
+                self._tokens = min(float(self.capacity), self._tokens + refill)
+            self._last_ts = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
 
 @dataclasses.dataclass
 class ReplicaSpec:
@@ -112,8 +291,12 @@ class Replica:
             page_size=spec.page_size,
             num_pages=spec.num_pages,
         )
+        scheduler = Scheduler(engine, slo_ttft_ms=spec.slo_ttft_ms)
+        # the replica_slow chaos seam keys on this index so one replica can
+        # be made gray (slow-but-alive) while its peers stay fast
+        scheduler.replica_index = self.index
         self.server = ServeServer(
-            Scheduler(engine, slo_ttft_ms=spec.slo_ttft_ms),
+            scheduler,
             secret=self.secret,
             name=f"replica-{self.index}",
         )
@@ -213,6 +396,8 @@ class Replica:
             pack,
             deadline_s=float(deadline_s) if deadline_s else None,
             trace=payload.get("trace"),
+            tenant=payload.get("tenant"),
+            qos=payload.get("qos"),
         )
         return req.id
 

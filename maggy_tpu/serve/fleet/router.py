@@ -64,7 +64,16 @@ from maggy_tpu.serve.fleet.prefill import (
     PrefillWorkerError,
     pick_worker,
 )
-from maggy_tpu.serve.fleet.replica import DEAD, UP, Replica
+from maggy_tpu.serve.fleet.replica import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    DEAD,
+    UP,
+    CircuitBreaker,
+    Replica,
+    RetryBudget,
+)
+from maggy_tpu.serve.qos import BEST_EFFORT, QOS_CLASSES, validate_qos
 from maggy_tpu.serve.scheduler import LATENCY_SIGNALS
 from maggy_tpu.telemetry import timeseries, tracing
 from maggy_tpu.telemetry.alerts import AlertEvaluator
@@ -97,6 +106,28 @@ class RouterConfig:
     quarantine_cooldown_s: float = 30.0
     max_restarts: int = 1  # fleet-wide respawn budget
     default_service_ms: float = 100.0  # TTFT prior before any p50 exists
+    # gray-failure circuit breakers (docs/resilience.md): a replica whose
+    # windowed TTFT p95 exceeds breaker_ratio x the best healthy peer's
+    # (and breaker_min_ms absolute) for breaker_trips consecutive metric
+    # ticks is ejected from dispatch; after breaker_cooldown_s, half-open
+    # probation probes close it on recovery
+    breaker_ratio: float = 3.0
+    breaker_min_ms: float = 50.0
+    breaker_window_s: float = 10.0
+    breaker_trips: int = 2
+    breaker_cooldown_s: float = 5.0
+    # brownout ladder (docs/fleet.md "QoS classes & graceful degradation"):
+    # while the TTFT SLO burn-rate alert fires, degrade best-effort one
+    # step per brownout_escalate_s (clamp max_new → queue-only → shed);
+    # step back down one level per brownout_recover_s of clean burn
+    brownout_clamp_tokens: int = 8
+    brownout_escalate_s: float = 3.0
+    brownout_recover_s: float = 5.0
+    # per-replica requeue budget: a flapping replica may inject at most
+    # retry_budget requeues per retry_budget_window_s; beyond that the
+    # requeues are deferred (never dropped) so storms can't amplify load
+    retry_budget: int = 8
+    retry_budget_window_s: float = 10.0
 
     def validate(self) -> None:
         if self.admission not in ("queue", "shed"):
@@ -122,6 +153,80 @@ def projected_ttft_ms(stats: Dict[str, Any], prior_ms: float) -> float:
     return float(p50) * (1.0 + waves)
 
 
+# brownout ladder levels, in escalation order (docs/fleet.md "QoS classes
+# & graceful degradation"); the level is the fleet.brownout_level gauge
+BROWNOUT_LEVELS = ("normal", "clamp", "queue", "shed")
+
+
+class BrownoutLadder:
+    """Hysteretic stepwise degradation of best-effort traffic.
+
+    While the SLO burn-rate alert fires, escalate one level per
+    ``escalate_s``: 1 clamps best-effort ``max_new`` at dispatch, 2 parks
+    best-effort in the router queue (dispatch skips it), 3 sheds
+    best-effort at admission with a typed BUSY. While the alert is clear,
+    recover one level per ``recover_s``. Single-step transitions in both
+    directions — never a cliff where premium misses SLO while best-effort
+    streams, and never a thundering re-admission when the burn clears.
+
+    Stepped by the pump's metrics tick, read by the RPC admission handler
+    and the dispatch loop; the lock makes each timed transition atomic.
+    """
+
+    def __init__(self, escalate_s: float = 3.0, recover_s: float = 5.0):
+        self.escalate_s = float(escalate_s)
+        self.recover_s = float(recover_s)
+        self._lock = lockdebug.lock("router.brownout")
+        self._level = 0  # guarded-by: _lock
+        self._burn_since: Optional[float] = None  # guarded-by: _lock
+        self._clear_since: Optional[float] = None  # guarded-by: _lock
+        # (ts, level) transition log — deterministic test/ops evidence
+        self.history: List[Tuple[float, int]] = []  # guarded-by: _lock
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def step(self, burning: bool, now: float) -> Tuple[int, Optional[str]]:  # thread-entry — router pump's ~1 Hz metrics tick
+        """Advance the ladder one tick; returns (level, transition) where
+        transition is ``"escalated"``/``"recovered"`` when the level moved."""
+        with self._lock:
+            transition = None
+            if burning:
+                self._clear_since = None
+                if self._burn_since is None:
+                    self._burn_since = now
+                if (
+                    self._level < len(BROWNOUT_LEVELS) - 1
+                    and now - self._burn_since >= self.escalate_s
+                ):
+                    self._level += 1
+                    self._burn_since = now  # one step per escalate_s
+                    self.history.append((now, self._level))
+                    transition = "escalated"
+            else:
+                self._burn_since = None
+                if self._clear_since is None:
+                    self._clear_since = now
+                if (
+                    self._level > 0
+                    and now - self._clear_since >= self.recover_s
+                ):
+                    self._level -= 1
+                    self._clear_since = now  # one step per recover_s
+                    self.history.append((now, self._level))
+                    transition = "recovered"
+            return self._level, transition
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "level": self._level,
+                "name": BROWNOUT_LEVELS[self._level],
+                "history": [(round(t, 3), lvl) for t, lvl in self.history],
+            }
+
+
 @dataclasses.dataclass
 class RouteEntry:
     """One router-owned request and its sticky downstream binding."""
@@ -143,6 +248,13 @@ class RouteEntry:
     cancel_requested: bool = False
     cancel_sent: bool = False
     counted_done: bool = False
+    # retry-budget damping: a requeue charged against an exhausted budget
+    # waits until this instant before redispatch (deferred, never dropped)
+    not_before_ts: Optional[float] = None
+
+    @property
+    def qos(self) -> str:
+        return self.payload.get("qos", BEST_EFFORT)
 
     def done(self) -> bool:
         if self.final is not None:
@@ -163,6 +275,8 @@ class RouteEntry:
                 "prompt_len": len(self.payload.get("prompt", [])),
                 "error": None,
                 "ttft_ms": None,
+                "tenant": self.payload.get("tenant"),
+                "qos": self.qos,
                 "done": False,
             }
         body["id"] = self.rid
@@ -250,12 +364,45 @@ class Router:
             # packs handed to a decode replica (docs/fleet.md)
             "prefilled": 0,
             "handoffs": 0,
+            # requeues damped by an exhausted per-replica retry budget and
+            # best-effort dispatches clamped by the brownout ladder
+            "retry_deferred": 0,
+            "brownout_clamped": 0,
         }
         # exact SLO attainment at the fleet edge: counted per completed
         # request against the configured TTFT budget (histogram-derived
         # attainment in SSTATS is the bucket-resolution view of the same)
         self.slo_ok = 0
         self.slo_miss = 0
+        # per-QoS-class split of the same fleet-edge judgement, so the
+        # no-cliff property (premium holds while best-effort degrades) is
+        # observable from SSTATS alone  # guarded-by: _lock
+        self.slo_by_class: Dict[str, Dict[str, int]] = {
+            c: {"ok": 0, "miss": 0} for c in QOS_CLASSES
+        }
+        # gray-failure circuit breakers + requeue budgets, one per replica
+        # (docs/resilience.md "Gray failure & circuit breakers"); breakers
+        # are scored by the pump's metrics tick and filter dispatch
+        cfg = self.config
+        self.breakers: Dict[int, CircuitBreaker] = {
+            r.index: CircuitBreaker(
+                r.index, trips=cfg.breaker_trips,
+                cooldown_s=cfg.breaker_cooldown_s,
+            )
+            for r in self.replicas
+        }
+        self.retry_budgets: Dict[int, RetryBudget] = {
+            r.index: RetryBudget(cfg.retry_budget, cfg.retry_budget_window_s)
+            for r in self.replicas
+        }
+        # brownout ladder: stepped by the pump tick off the SLO burn alert
+        self.brownout = BrownoutLadder(
+            escalate_s=cfg.brownout_escalate_s,
+            recover_s=cfg.brownout_recover_s,
+        )
+        # shed sequence staggers retry_after_ms hints so synchronized
+        # clients desynchronize instead of re-storming  # guarded-by: _lock
+        self._shed_seq = 0
         self._log: deque = deque(maxlen=500)
         self._closing = False
         self._stop = threading.Event()
@@ -378,12 +525,28 @@ class Router:
     ) -> Dict[str, Any]:
         with self._lock:
             self.counters["shed"] += 1
+            seq = self._shed_seq
+            self._shed_seq += 1
+            # retry hint = projected router-queue drain time: pending
+            # requests served num_slots at a time across healthy replicas,
+            # one service interval per wave; floor keeps an empty-queue
+            # shed (no healthy replica, shutdown) from hinting "now"
+            slots = sum(r.spec.num_slots for r in self._healthy()) or 1
+            drain_ms = max(
+                100.0,
+                len(self._pending) * self.config.default_service_ms / slots,
+            )
+        # stagger consecutive sheds across [0, drain_ms) so the retry wave
+        # spreads instead of landing as one synchronized storm
+        retry_ms = drain_ms + (seq % 8) * drain_ms / 8.0
         self.telemetry.count("fleet.shed")
         self.telemetry.event("req.shed", trace=trace, reason=why)
         reply: Dict[str, Any] = {"type": "BUSY", "error": why}
         if projected is not None:
             reply["projected_ttft_ms"] = round(projected, 1)
-        reply["retry_after_s"] = 0.25
+        reply["retry_after_ms"] = round(retry_ms, 1)
+        # legacy field older clients sleep on; same hint, coarser unit
+        reply["retry_after_s"] = round(retry_ms / 1e3, 3)
         return reply
 
     def _on_submit(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -392,6 +555,14 @@ class Router:
             isinstance(t, int) for t in prompt
         ):
             raise ValueError("prompt must be a list of token ids")
+        qos = validate_qos(msg.get("qos"))
+        tenant = str(msg.get("tenant") or "") or None
+        # brownout level 3: shed best-effort at the door with a typed BUSY
+        # (premium/standard admission is untouched at every level)
+        if qos == BEST_EFFORT and self.brownout.level() >= 3:
+            return self._busy(
+                "brownout: best-effort shed", trace=msg.get("trace")
+            )
         with self._lock:
             if self._closing:
                 return self._busy("router shutting down")
@@ -442,7 +613,10 @@ class Router:
                 "eos_id": int(msg.get("eos_id", -1)),
                 "seed": int(msg.get("seed", 0)),
                 "trace": trace,
+                "qos": qos,
             }
+            if tenant:
+                payload["tenant"] = tenant
             entry = RouteEntry(rid=rid, payload=payload, trace=trace)
             deadline_s = msg.get("deadline_s")
             if deadline_s:
@@ -451,7 +625,8 @@ class Router:
             self._entries[rid] = entry
             self._pending.append(rid)
         self.telemetry.event(
-            "req.accepted", trace=trace, rid=rid, plen=len(prompt)
+            "req.accepted", trace=trace, rid=rid, plen=len(prompt),
+            tenant=tenant, qos=qos,
         )
         return {"type": "SUBMIT", "id": rid}
 
@@ -532,9 +707,11 @@ class Router:
             local = getattr(r, "local_stats", lambda: None)()
             stats = local or self._stats_cache.get(r.index, {})
             quarantined = self.quarantine.is_quarantined(r.index, now)
+            breaker = self.breakers.get(r.index)
             row = {
                 **r.describe(),
                 "quarantined": quarantined,
+                "breaker": breaker.state if breaker is not None else None,
                 "queue_depth": stats.get("queue_depth", 0),
                 "active_slots": stats.get("active_slots", 0),
                 "num_slots": stats.get("num_slots", r.spec.num_slots),
@@ -598,6 +775,20 @@ class Router:
                 if judged
                 else (ttft.attainment(self.config.slo_ttft_ms) if ttft else None)
             )
+        # overload-robustness surfaces (docs/fleet.md "QoS classes &
+        # graceful degradation", docs/resilience.md "Gray failure"):
+        # ladder level, per-replica breaker states, per-class SLO split
+        agg["brownout"] = self.brownout.snapshot()
+        agg["breaker_open"] = sum(
+            1 for b in self.breakers.values() if b.state != BREAKER_CLOSED
+        )
+        agg["breakers"] = {
+            str(i): b.snapshot() for i, b in self.breakers.items()
+        }
+        if self.config.slo_ttft_ms is not None:
+            agg["slo_by_class"] = {
+                c: dict(v) for c, v in self.slo_by_class.items()
+            }
         if self.autopilot is not None:
             agg["autopilot"] = self.autopilot.status()
         # ALERTS surface: fleet-scope rules plus whatever each replica's
@@ -717,9 +908,72 @@ class Router:
                 }
         elif have_replica_slo:
             counters = {"serve.slo_ok": slo_ok_sum, "serve.slo_miss": slo_miss_sum}
+        # brownout ladder: stepped off the LAST tick's burn-rate verdict
+        # (one-tick lag is in the noise next to the hysteresis windows);
+        # the level gauge lands in the same ingest the alert.brownout
+        # threshold rule reads, so entry/exit alerts fire for free
+        burning = any(
+            a.get("alert") == "alert.ttft_slo_burn" for a in self.alerts.firing()
+        )
+        level, transition = self.brownout.step(burning, now)
+        if transition is not None:
+            self.log(
+                f"brownout {transition} -> level {level} "
+                f"({BROWNOUT_LEVELS[level]})"
+            )
+        fleet_gauges["fleet.brownout_level"] = float(level)
+        self.telemetry.gauge("fleet.brownout_level", float(level))
+        # gray-failure breaker scoring over the per-replica windowed TTFT
+        # p95s ingested above (docs/resilience.md)
+        self._score_breakers(now)
+        open_count = sum(
+            1 for b in self.breakers.values() if b.state != BREAKER_CLOSED
+        )
+        fleet_gauges["fleet.breaker_open"] = float(open_count)
+        self.telemetry.gauge("fleet.breaker_open", float(open_count))
         self.metrics.ingest(now, gauges=fleet_gauges, counters=counters, hists=merged_hists)
         self.alerts.evaluate(now)
         self.telemetry.gauge("alerts.firing", float(len(self.alerts.firing())))
+
+    def _score_breakers(self, now: float) -> None:
+        """Feed each dispatchable replica's windowed TTFT p95 to its
+        breaker, scored against the BEST (minimum) peer p95 — with two
+        replicas a median would be dragged up by the gray one, so the
+        healthy peer is the honest baseline (pump thread)."""
+        cfg = self.config
+        p95s: Dict[int, Optional[float]] = {}
+        for r in self.replicas:
+            if r.state != UP or getattr(r.spec, "role", "any") == "prefill":
+                continue
+            with self._lock:
+                store = self.replica_metrics.get(r.index)
+            series = store.get("serve.ttft_ms") if store is not None else None
+            p95s[r.index] = (
+                series.percentile(0.95, cfg.breaker_window_s, now)
+                if series is not None
+                else None
+            )
+        for idx, p95 in p95s.items():
+            breaker = self.breakers.get(idx)
+            if breaker is None:
+                continue
+            peers = [
+                v
+                for i, v in p95s.items()
+                if i != idx
+                and v is not None
+                and self.breakers[i].state == BREAKER_CLOSED
+            ]
+            peer = min(peers) if peers else None
+            transition = breaker.score(
+                p95, peer, cfg.breaker_ratio, cfg.breaker_min_ms, now
+            )
+            if transition == "opened":
+                self.telemetry.count("fleet.breaker_opened")
+                self.log(
+                    f"breaker OPEN on replica {idx}: ttft p95 "
+                    f"{p95:.0f}ms vs peer {peer:.0f}ms"
+                )
 
     def _on_status(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
@@ -824,13 +1078,22 @@ class Router:
 
     def _handle_replica_down(self, replica: Replica) -> None:
         """Requeue the dead/quarantined replica's in-flight requests ahead
-        of fresh arrivals, then respawn it if budget remains."""
+        of fresh arrivals, then respawn it if budget remains. Requeues
+        beyond the replica's retry budget are deferred (not_before_ts), so
+        a flapping replica can't turn its backlog into a requeue storm."""
+        now = time.time()
+        # a half-open probation probe bound here is lost, not answered
+        breaker = self.breakers.get(replica.index)
+        if breaker is not None:
+            breaker.probe_lost()
         with self._lock:
             if replica.index in self._down_handled:
                 return
             self._down_handled.add(replica.index)
             moved = 0
+            deferred = 0
             requeued_entries = []
+            budget = self.retry_budgets.get(replica.index)
             for entry in self._entries.values():
                 if entry.replica == replica.index and not entry.done():
                     entry.state = REQUEUED
@@ -838,10 +1101,18 @@ class Router:
                     entry.remote_id = None
                     entry.snapshot = None
                     entry.resubmits += 1
+                    if budget is not None and not budget.consume(now):
+                        # budget dry: still requeued, but the dispatch loop
+                        # waits this entry out (backoff grows per resubmit)
+                        entry.not_before_ts = now + 0.25 * entry.resubmits
+                        deferred += 1
                     self._pending.appendleft(entry.rid)
                     requeued_entries.append(entry)
                     moved += 1
             self.counters["requeued"] += moved
+            self.counters["retry_deferred"] += deferred
+        if deferred:
+            self.telemetry.count("fleet.retry_deferred", deferred)
         for entry in requeued_entries:
             # explicit hop milestone: the SAME trace id continues on the
             # survivor, so the exported lane shows the loss + re-run inline
@@ -912,33 +1183,116 @@ class Router:
 
     def _dispatch_pending(self, now: float) -> None:
         while True:
+            level = self.brownout.level()
             with self._lock:
                 if not self._pending:
                     return
-                rid = self._pending[0]
+                healthy = self._healthy()
+                if not healthy:
+                    return
+                # breaker gate: open breakers leave the dispatch set; when
+                # EVERY candidate is breaker-blocked, fail static to the
+                # full healthy set — a breaker sidelines a gray replica, it
+                # must never cause a total outage (docs/resilience.md)
+                candidates = [
+                    r for r in healthy if self.breakers[r.index].ok(now)
+                ]
+                breaker_gated = bool(candidates)
+                if not candidates:
+                    candidates = healthy
+                cfg = self.config
+                # SLO queue-hold, best-effort only: when the best replica
+                # projects over budget, fresh best-effort parks here (cheap
+                # to cancel/requeue) while premium/standard dispatch and
+                # ride the replica-side priority admission + quota floor —
+                # the class-blind hold would head-of-line-block premium
+                # behind the very flood it needs to outrank
+                hold_best_effort = False
+                if cfg.slo_ttft_ms is not None and cfg.admission == "queue":
+                    proj_min = min(
+                        projected_ttft_ms(
+                            self._stats_cache.get(r.index, {}),
+                            cfg.default_service_ms,
+                        )
+                        for r in candidates
+                    )
+                    hold_best_effort = proj_min > cfg.slo_ttft_ms
+                # scan for the first dispatchable entry: requeues damped by
+                # an exhausted retry budget wait out not_before_ts, and at
+                # brownout level >= 2 best-effort parks in the queue while
+                # premium/standard behind it still dispatches
+                idx = action = None
+                for i, rid in enumerate(self._pending):
+                    entry = self._entries.get(rid)
+                    if entry is None or entry.done():
+                        idx, action = i, "drop"
+                        break
+                    if entry.deadline_ts is not None and now > entry.deadline_ts:
+                        idx, action = i, "expire"
+                        break
+                    if (
+                        entry.not_before_ts is not None
+                        and now < entry.not_before_ts
+                    ):
+                        continue
+                    if entry.qos == BEST_EFFORT and (
+                        level >= 2
+                        or (hold_best_effort and entry.state == PENDING)
+                    ):
+                        continue
+                    idx, action = i, "dispatch"
+                    break
+                if idx is None:
+                    return
+                rid = self._pending[idx]
                 entry = self._entries.get(rid)
-                if entry is None or entry.done():
-                    self._pending.popleft()
+                if action == "drop":
+                    del self._pending[idx]
                     continue
-                if entry.deadline_ts is not None and now > entry.deadline_ts:
-                    self._pending.popleft()
+                if action == "expire":
+                    del self._pending[idx]
                     self._finish_local(
                         entry, "expired", "deadline exceeded in router queue"
                     )
                     continue
-                healthy = self._healthy()
-                if not healthy:
-                    return
-                best, proj = self._pick_replica(healthy)
-                cfg = self.config
+                best, proj = self._pick_replica(candidates)
+                if breaker_gated:
+                    # probation first: a half-open replica can never win the
+                    # latency pick (its cached stats are the slow ones that
+                    # tripped it), so the canary dispatch is routed to it
+                    # deliberately — one request per cooldown, by the
+                    # breaker's single-probe claim
+                    for r in candidates:
+                        b = self.breakers[r.index]
+                        if b.state == BREAKER_HALF_OPEN and b.take_probe(rid):
+                            best = r
+                            break
+                    else:
+                        if not self.breakers[best.index].take_probe(rid):
+                            # best is half-open with its probe already out:
+                            # try the others, else wait the round out
+                            remaining = [
+                                r for r in candidates if r.index != best.index
+                            ]
+                            if not remaining:
+                                return
+                            best, proj = self._pick_replica(remaining)
+                            if not self.breakers[best.index].take_probe(rid):
+                                return
+                entry.not_before_ts = None
+                del self._pending[idx]
+                # brownout level >= 1: clamp best-effort output length for
+                # this dispatch (the entry keeps its full payload, so a
+                # requeue after recovery replays unclamped)
+                payload = entry.payload
                 if (
-                    cfg.slo_ttft_ms is not None
-                    and cfg.admission == "queue"
-                    and entry.state == PENDING
-                    and proj > cfg.slo_ttft_ms
+                    level >= 1
+                    and entry.qos == BEST_EFFORT
+                    and int(payload.get("max_new", 16)) > cfg.brownout_clamp_tokens
                 ):
-                    return  # hold fresh work until capacity projects in-SLO
-                self._pending.popleft()
+                    payload = dict(payload, max_new=max(1, cfg.brownout_clamp_tokens))
+                    self.counters["brownout_clamped"] += 1
+                    self.telemetry.count("fleet.brownout_clamped")
             # milestone BEFORE the downstream round-trip: the replica's own
             # req.queued lands mid-flight, so stamping after the reply
             # would scramble the lane's dispatched→queued ordering
@@ -948,15 +1302,17 @@ class Router:
             )
             remote_id = None
             if self.prefill_workers:
-                remote_id = self._dispatch_disaggregated(entry, best)
+                remote_id = self._dispatch_disaggregated(entry, best, payload)
             if remote_id is None:
                 try:
-                    remote_id = best.client.submit(**entry.payload)
+                    remote_id = best.client.submit(**payload)
                 except RpcRejectedError as e:
+                    self.breakers[best.index].probe_lost(rid)
                     with self._lock:
                         self._finish_local(entry, "failed", str(e))
                     continue
                 except (RpcError, OSError) as e:
+                    self.breakers[best.index].probe_lost(rid)
                     with self._lock:
                         entry.state = REQUEUED
                         self._pending.appendleft(rid)
@@ -972,20 +1328,24 @@ class Router:
                 cached["queue_depth"] = cached.get("queue_depth", 0) + 1
             self.telemetry.count("fleet.routed")
 
-    def _dispatch_disaggregated(self, entry: RouteEntry, best: Replica):
+    def _dispatch_disaggregated(
+        self, entry: RouteEntry, best: Replica, payload: Optional[Dict[str, Any]] = None
+    ):
         """Disaggregated dispatch (pump thread): run the prompt on a
         prefill replica, hand the KV pack to the chosen decode replica.
         Returns the downstream request id, or None to fall back to plain
         dispatch (prefill fleet down / handoff unsupported) — the decode
         replica's full engine then prefills for itself, so disaggregation
-        degrades, never outages."""
+        degrades, never outages. ``payload`` overrides the entry's payload
+        when the brownout ladder clamped this dispatch."""
+        payload = payload if payload is not None else entry.payload
         worker = pick_worker(self.prefill_workers, self._pw_rr)
         self._pw_rr += 1
         if worker is None:
             return None
         t0 = time.perf_counter()
         try:
-            pack = worker.prefill(entry.payload)
+            pack = worker.prefill(payload)
         except PrefillWorkerError as e:
             self.log(f"prefill fallback: {e}")
             return None
@@ -994,10 +1354,10 @@ class Router:
         self.telemetry.event(
             "req.prefilled", trace=entry.trace, rid=entry.rid,
             replica=worker.index,
-            plen=len(entry.payload.get("prompt", [])),
+            plen=len(payload.get("prompt", [])),
         )
         try:
-            remote_id = best.submit_prefilled(entry.payload, pack)
+            remote_id = best.submit_prefilled(payload, pack)
         except Exception as e:  # noqa: BLE001 - dead/remote decode replica: plain dispatch retries
             self.log(f"handoff fallback: {type(e).__name__}: {e}")
             return None
@@ -1036,7 +1396,10 @@ class Router:
                             entry.cancel_sent = True
                 snap = replica.client.poll(remote_id)
             except RpcRejectedError:
-                # replica forgot the id (restart/retention): replay it
+                # replica forgot the id (restart/retention): replay it,
+                # charged against the replica's retry budget
+                self.breakers[idx].probe_lost(rid)
+                now = time.time()
                 requeued_entry = None
                 with self._lock:
                     entry = self._entries.get(rid)
@@ -1046,6 +1409,11 @@ class Router:
                         entry.remote_id = None
                         entry.snapshot = None
                         entry.resubmits += 1
+                        budget = self.retry_budgets.get(idx)
+                        if budget is not None and not budget.consume(now):
+                            entry.not_before_ts = now + 0.25 * entry.resubmits
+                            self.counters["retry_deferred"] += 1
+                            self.telemetry.count("fleet.retry_deferred")
                         self.counters["requeued"] += 1
                         self._pending.appendleft(rid)
                         requeued_entry = entry
@@ -1056,8 +1424,27 @@ class Router:
                     )
                 continue
             except (RpcError, OSError) as e:
+                self.breakers[idx].probe_lost(rid)
                 self._note_failure(replica, f"poll: {type(e).__name__}")
                 return
+            # gray-failure probation: the probe's first observed TTFT is
+            # the verdict (the breaker ignores every other rid)
+            if snap.get("ttft_ms") is not None:
+                verdict = self.breakers[idx].observe_ttft(
+                    rid, float(snap["ttft_ms"]), time.time()
+                )
+                if verdict == "closed":
+                    self.telemetry.count("fleet.breaker_closed")
+                    self.log(
+                        f"breaker CLOSED on replica {idx} (probe ttft "
+                        f"{snap['ttft_ms']:.0f}ms)"
+                    )
+                elif verdict == "reopened":
+                    self.telemetry.count("fleet.breaker_opened")
+                    self.log(
+                        f"breaker RE-OPENED on replica {idx} (probe ttft "
+                        f"{snap['ttft_ms']:.0f}ms)"
+                    )
             completed = None
             with self._lock:
                 entry = self._entries.get(rid)
@@ -1075,15 +1462,21 @@ class Router:
                     self.counters[key] += 1
                     completed = entry
                     # exact fleet-edge SLO attainment, judged on the TTFT
-                    # the serving replica measured for this request
+                    # the serving replica measured for this request, split
+                    # per QoS class for the no-cliff view
                     if (
                         self.config.slo_ttft_ms is not None
                         and snap.get("ttft_ms") is not None
                     ):
+                        by_class = self.slo_by_class.get(entry.qos)
                         if snap["ttft_ms"] <= self.config.slo_ttft_ms:
                             self.slo_ok += 1
+                            if by_class is not None:
+                                by_class["ok"] += 1
                         else:
                             self.slo_miss += 1
+                            if by_class is not None:
+                                by_class["miss"] += 1
             if completed is not None:
                 self.telemetry.event(
                     "req.completed", trace=completed.trace, rid=rid,

@@ -13,6 +13,8 @@ import secrets
 import time
 from typing import Any, Dict, List, Optional
 
+from maggy_tpu.serve.qos import DEFAULT_QOS, DEFAULT_TENANT
+
 # terminal states never transition again; the scheduler drops terminal
 # requests from its index after RETENTION_S so poll() has a grace window
 QUEUED = "queued"
@@ -74,6 +76,11 @@ class Request:
     # times this request was preempted for pages (docs/serving.md); its
     # generated tokens are retained and re-admission resumes byte-identically
     preemptions: int = 0
+    # per-tenant QoS (docs/fleet.md "QoS classes"): tenant is the accounting
+    # identity, qos the scheduling class (admission priority, quota ledger
+    # bucket, preemption ordering); wire default is best_effort
+    tenant: str = DEFAULT_TENANT
+    qos: str = DEFAULT_QOS
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -119,5 +126,8 @@ class Request:
             "prompt_len": len(self.prompt),
             "error": self.error,
             "ttft_ms": self.ttft_ms,
+            "tenant": self.tenant,
+            "qos": self.qos,
+            "preemptions": self.preemptions,
             "done": self.state in TERMINAL,
         }

@@ -1,8 +1,10 @@
-"""FCFS request scheduler and the engine loop.
+"""QoS-aware request scheduler and the engine loop.
 
 One daemon thread owns the engine: it admits queued requests whenever slots
-free up (prefill interleaved with decode), decodes one token per active slot
-per iteration, and retires requests on EOS / ``max_new`` / cancellation /
+free up (prefill interleaved with decode) — highest QoS class first, FIFO
+within a class, bounded by the weighted token quotas in
+:mod:`maggy_tpu.serve.qos` — decodes one token per active slot per
+iteration, and retires requests on EOS / ``max_new`` / cancellation /
 deadline. RPC handlers only touch the queue and request index under the
 scheduler lock — they never block on device work, which keeps the asyncio
 socket loop responsive while XLA crunches.
@@ -30,15 +32,23 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional
 
 from maggy_tpu import telemetry
 from maggy_tpu.core import lockdebug
 from maggy_tpu.exceptions import BadArgumentsError
+from maggy_tpu.resilience import chaos as chaos_mod
 from maggy_tpu.serve import request as rq
 from maggy_tpu.serve.engine import Engine
 from maggy_tpu.serve.paging import OutOfPagesError
+from maggy_tpu.serve.qos import (
+    DEFAULT_TENANT,
+    QOS_CLASSES,
+    QOS_PRIORITY,
+    QosQueue,
+    QuotaLedger,
+    validate_qos,
+)
 from maggy_tpu.serve.request import Request, SamplingParams
 from maggy_tpu.telemetry import flightrec, timeseries, tracing
 from maggy_tpu.telemetry.alerts import AlertEvaluator, RecompileSentinel
@@ -63,6 +73,8 @@ class Scheduler:
         retention_s: float = RETENTION_S,
         slo_ttft_ms: Optional[float] = None,
         autopilot=None,
+        qos_weights: Optional[Dict[str, float]] = None,
+        qos_window_s: float = 5.0,
     ):
         self.engine = engine
         self.max_queue = max_queue
@@ -92,7 +104,22 @@ class Scheduler:
             )
         self._lock = lockdebug.rlock("scheduler._lock")
         self._wake = threading.Condition(self._lock)
-        self._queue: deque = deque()  # FCFS: append right, pop left
+        # class-ordered admission queue (docs/fleet.md "QoS classes"):
+        # priority then arrival within a class; preemption/backpressure
+        # requeues go to the front of their own class
+        self._queue = QosQueue()  # guarded-by: _lock
+        # weighted decode-token quotas; the loop charges per emitted token,
+        # admission defers over-share classes while others wait
+        self.quota = QuotaLedger(weights=qos_weights, window_s=qos_window_s)
+        # per-class lifetime counts (admitted/preempted/quota_deferred),
+        # mirrored as serve.qos.* counters and in the stats() qos block
+        self.qos_counters: Dict[str, Dict[str, int]] = {
+            c: {"admitted": 0, "preempted": 0, "quota_deferred": 0}
+            for c in QOS_CLASSES
+        }  # guarded-by: _lock
+        # which fleet replica this scheduler serves (set by Replica.start);
+        # the replica_slow chaos seam keys on it to make one replica gray
+        self.replica_index: Optional[int] = None
         self._requests: Dict[str, Request] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -141,10 +168,16 @@ class Scheduler:
         params: Optional[SamplingParams] = None,
         deadline_s: Optional[float] = None,
         trace: Optional[str] = None,
+        tenant: Optional[str] = None,
+        qos: Optional[str] = None,
         _pack: Optional[Dict[str, Any]] = None,
     ) -> Request:
         params = params or SamplingParams()
         params.validate()
+        try:
+            qos = validate_qos(qos)
+        except ValueError as e:
+            raise BadArgumentsError(str(e)) from None
         if not prompt:
             raise BadArgumentsError("empty prompt")
         if len(prompt) + params.max_new > self.engine.max_seq_len:
@@ -166,7 +199,8 @@ class Scheduler:
                     "max_pages_per_req or the pool)"
                 )
         req = Request(prompt=[int(t) for t in prompt], params=params,
-                      prefilled=_pack)
+                      prefilled=_pack, qos=qos,
+                      tenant=str(tenant) if tenant else DEFAULT_TENANT)
         # adopt the caller's trace id (SUBMIT frame / ambient RPC scope) so
         # the request's lifecycle correlates with its client-side journey;
         # direct in-process submits get a fresh one
@@ -186,6 +220,7 @@ class Scheduler:
         self.telemetry.event(
             "req.queued", trace=req.trace, rid=req.id,
             plen=len(req.prompt), max_new=params.max_new,
+            tenant=req.tenant, qos=req.qos,
         )
         return req
 
@@ -196,6 +231,8 @@ class Scheduler:
         pack: Dict[str, Any],
         deadline_s: Optional[float] = None,
         trace: Optional[str] = None,
+        tenant: Optional[str] = None,
+        qos: Optional[str] = None,
     ) -> Request:
         """Disaggregated handoff entry (docs/fleet.md "Disaggregated
         prefill/decode"): like :meth:`submit`, but the prompt's KV was
@@ -205,7 +242,7 @@ class Scheduler:
         the first token is the ordinary decode path."""
         return self.submit(
             prompt, params, deadline_s=deadline_s, trace=trace,
-            _pack=dict(pack),
+            tenant=tenant, qos=qos, _pack=dict(pack),
         )
 
     def poll(self, request_id: str) -> Dict[str, Any]:
@@ -326,6 +363,16 @@ class Scheduler:
                 "compile_counts": engine.compile_counts,
                 "paging": engine.paging_stats,
                 "preemptions": self.preemptions,
+                # per-class QoS view (docs/fleet.md "QoS classes"): queue
+                # depths, lifetime admission/preempt/defer counts, and the
+                # quota ledger's windowed token shares
+                "qos": {
+                    "queued": self._queue.depths(),
+                    "counters": {
+                        c: dict(v) for c, v in self.qos_counters.items()
+                    },
+                    "quota": self.quota.snapshot(),
+                },
                 **engine.prefix_stats,
             }
         ttft = hists["ttft_ms"]
@@ -409,6 +456,8 @@ class Scheduler:
     def _emit(self, req: Request, token: int, now: float) -> bool:  # guarded-by: _lock
         """Append a generated token; True when the request just finished."""
         req.tokens.append(int(token))
+        # quota accounting: one windowed decode token against the class
+        self.quota.charge(req.qos, 1, now)
         if req.first_token_ts is None:
             req.first_token_ts = now
             ttft = req.ttft_ms
@@ -432,21 +481,45 @@ class Scheduler:
         return False
 
     def _admit_ready(self, now: float) -> None:
-        """Admit queued requests into free slots, FCFS; drop dead ones.
+        """Admit queued requests into free slots — priority class first,
+        FIFO within a class, quota-deferred classes skipped while another
+        class waits under share; drop dead ones.
 
         A dry page pool (:class:`OutOfPagesError`) is BACKPRESSURE, not
-        failure: the head request goes back to the queue front and
+        failure: the head request goes back to the front of its class and
         admission pauses until running requests finish or preemption frees
         pages — no request is ever refused for memory pressure (only a
-        request that could never fit fails, at submit)."""
+        request that could never fit fails, at submit). A waiting class
+        that strictly outranks an active row never waits for natural
+        turnover, though: it preempts the lowest-class youngest row
+        (slot AND pages), so premium TTFT is bounded by a prefill, not
+        by a victim's remaining decode."""
         with self._lock:
             if self._pending_slots is not None:
                 return  # drain-and-reconfigure in progress: let the wave empty
-        while self.engine.slots.free_slots():
-            with self._lock:
-                if not self._queue:
+        while True:
+            if not self.engine.slots.free_slots():
+                with self._lock:
+                    waiting = self._queue.classes_waiting()
+                if not waiting or not self._preempt_lower_class(waiting[0]):
                     return
-                req = self._queue.popleft()
+            with self._lock:
+                req, deferred = self._queue.pop_next(self.quota, now)
+                if req is None:
+                    return
+                for cls in deferred:
+                    self.qos_counters[cls]["quota_deferred"] += 1
+            for cls in deferred:
+                self.telemetry.count(f"serve.qos.quota_deferred.{cls}")
+            # replica_slow chaos seam (docs/resilience.md "Gray failure"):
+            # a gray replica is alive but slow — inject the latency on the
+            # admission path, outside the lock, so its own TTFT histograms
+            # (what the router's breaker scores) absorb the slowness
+            ch = chaos_mod.get()
+            if ch is not None:
+                slow_s = ch.replica_slow(self.replica_index)
+                if slow_s > 0:
+                    time.sleep(slow_s)
             if req.cancel_requested:
                 with self._lock:
                     self._finish(req, rq.CANCELLED)
@@ -477,28 +550,45 @@ class Scheduler:
                 "req.prefix_admitted" if prefix_hit else "req.admitted",
                 trace=req.trace, rid=req.id, queue_wait_ms=wait_ms,
             )
-            try:
-                # the request's trace becomes ambient for the admission, so
-                # the engine's prefill/prefix-admit spans correlate with it
-                with tracing.scope(req.trace):
-                    if req.prefilled is not None:
-                        pack, req.prefilled = req.prefilled, None
-                        slot, first = self.engine.admit_from_kv(req, pack)
-                    else:
-                        slot, first = self.engine.admit(req)
-            except OutOfPagesError:
-                # pool dry: head of the line waits (ahead of everything)
-                with self._wake:
-                    self._queue.appendleft(req)
-                return
-            except Exception as e:  # noqa: BLE001 - a poison request must not kill the loop
-                with self._lock:
-                    self._finish(req, rq.FAILED, f"{type(e).__name__}: {e}")
+            pack, req.prefilled = req.prefilled, None
+            admitted = False
+            while True:
+                try:
+                    # the request's trace becomes ambient for the admission,
+                    # so the engine's prefill/prefix-admit spans correlate
+                    with tracing.scope(req.trace):
+                        if pack is not None:
+                            slot, first = self.engine.admit_from_kv(req, pack)
+                        else:
+                            slot, first = self.engine.admit(req)
+                    admitted = True
+                except OutOfPagesError:
+                    # a dry pool must not park a higher class behind
+                    # lower-class decodes: preempt strictly-lower-class
+                    # rows (lowest class, youngest first) until the
+                    # admission fits. Only same-or-higher-class occupancy
+                    # backpressures — then the head request goes back to
+                    # the front of its class (ahead of its peers; higher
+                    # classes still outrank it next round), keeping its
+                    # disaggregated-prefill pack for the next attempt
+                    if self._preempt_lower_class(req.qos):
+                        continue
+                    req.prefilled = pack
+                    with self._wake:
+                        self._queue.requeue_front(req)
+                    return
+                except Exception as e:  # noqa: BLE001 - a poison request must not kill the loop
+                    with self._lock:
+                        self._finish(req, rq.FAILED, f"{type(e).__name__}: {e}")
+                break
+            if not admitted:
                 continue
             with self._lock:
                 req.state = rq.RUNNING
+                self.qos_counters[req.qos]["admitted"] += 1
                 if self._emit(req, first, time.time()):
                     self._release_slot(slot)
+            tel.count(f"serve.qos.admitted.{req.qos}")
 
     def _release_slot(self, slot: int) -> None:
         """THE slot-vacating seam: every exit path (finish at emit, cancel,
@@ -543,12 +633,14 @@ class Scheduler:
 
     def _preempt_for_pages(self) -> None:
         """Paged decode ran the allocator dry (an active row crossed a page
-        boundary with no free page): preempt the YOUNGEST active request —
-        free its pages, requeue it at the FRONT of the queue with prompt
-        AND generated tokens retained — until every remaining row can grow.
-        Re-admission resumes the stream byte-identically
-        (docs/serving.md "Preemption"); admission order still favors the
-        preempted request over fresh arrivals."""
+        boundary with no free page): preempt the LOWEST-PRIORITY active
+        request, youngest within the class (PR 10's preempt-youngest is the
+        degenerate single-class case) — free its pages, requeue it at the
+        front of its class with prompt AND generated tokens retained — until
+        every remaining row can grow. Re-admission resumes the stream
+        byte-identically (docs/serving.md "Preemption"); the PRNG-chain
+        resume seam is untouched by the victim-ordering change, so a
+        preempted premium stream still completes bit-exact."""
         if not self.engine.paged:
             return
         while self.engine.prepare_step():
@@ -559,25 +651,83 @@ class Scheduler:
             actives = self.engine.slots.active_slots()
             if not actives:
                 return
-            victim = max(
-                actives,
-                key=lambda s: (
-                    self.engine.slots.get(s).request.admitted_ts or 0.0,
-                    s,
-                ),
-            )
+
+            def _rank(slot: int):
+                r = self.engine.slots.get(slot).request
+                # max() picks: largest priority number (lowest class), then
+                # most recent admission (youngest) within the class
+                return (QOS_PRIORITY.get(r.qos, len(QOS_CLASSES)),
+                        r.admitted_ts or 0.0, slot)
+
+            victim = max(actives, key=_rank)
             req = self.engine.slots.get(victim).request
-            self._release_slot(victim)
-            with self._wake:
-                req.state = rq.QUEUED
-                req.preemptions += 1
-                self._queue.appendleft(req)
-                self.preemptions += 1
-            self.telemetry.count("serve.preemptions")
-            self.telemetry.event(
-                "req.preempted", trace=req.trace, rid=req.id,
-                n_tokens=len(req.tokens), preemptions=req.preemptions,
+            # a victim chosen BY class (some active row outranks it) is a
+            # priority preemption, not just the youngest of equals
+            vp = QOS_PRIORITY.get(req.qos, len(QOS_CLASSES))
+            for_priority = any(
+                QOS_PRIORITY.get(self.engine.slots.get(s).request.qos, 0) < vp
+                for s in actives if s != victim
             )
+            self._preempt_victim(victim, for_priority)
+
+    def _preempt_victim(self, victim: int, for_priority: bool) -> None:
+        """THE victim seam shared by decode-growth and admission preemption:
+        release the slot (pages, anchor, row) through ``_release_slot``,
+        requeue the request at the front of its class with prompt AND
+        generated tokens retained, and account it — the byte-identical
+        resume guarantee lives entirely in this one path."""
+        req = self.engine.slots.get(victim).request
+        self._release_slot(victim)
+        with self._wake:
+            req.state = rq.QUEUED
+            req.preemptions += 1
+            self._queue.requeue_front(req)
+            self.preemptions += 1
+            self.qos_counters[req.qos]["preempted"] += 1
+        tel = self.telemetry
+        tel.count("serve.preemptions")
+        tel.count(f"serve.qos.preempted.{req.qos}")
+        tel.event(
+            "req.preempted", trace=req.trace, rid=req.id,
+            n_tokens=len(req.tokens), preemptions=req.preemptions,
+        )
+        if for_priority:
+            tel.event(
+                "req.preempted_for_priority", trace=req.trace, rid=req.id,
+                qos=req.qos, n_tokens=len(req.tokens),
+            )
+
+    def _preempt_lower_class(self, qos: str) -> bool:
+        """Free capacity (a slot and its pages) for a waiting higher-class
+        admission: preempt the active row QOS strictly outranks — lowest
+        class first, youngest within the class — and report whether
+        admission should retry. In-flight tokens drain first (a finish is
+        cheaper than a preempt, and may free the capacity by itself). A
+        same-class squeeze never preempts: FIFO-within-class backpressure
+        stays livelock-free."""
+        if not self.engine.paged:
+            return False
+        had_free = self.engine.slots.free_slots()
+        self._drain_inflight()
+        if self.engine.slots.free_slots() > had_free:
+            return True  # a finish freed slot + pages without a victim
+        rp = QOS_PRIORITY.get(qos, len(QOS_CLASSES))
+        victims = [
+            s for s in self.engine.slots.active_slots()
+            if QOS_PRIORITY.get(
+                self.engine.slots.get(s).request.qos, len(QOS_CLASSES)
+            ) > rp
+        ]
+        if not victims:
+            return False
+
+        def _rank(slot: int):
+            r = self.engine.slots.get(slot).request
+            return (QOS_PRIORITY.get(r.qos, len(QOS_CLASSES)),
+                    r.admitted_ts or 0.0, slot)
+
+        self._preempt_victim(max(victims, key=_rank), for_priority=True)
+        return True
 
     def _retire_old(self, now: float) -> None:
         with self._lock:

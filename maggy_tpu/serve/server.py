@@ -105,6 +105,8 @@ class ServeServer:
             # the frame's trace id (client- or router-minted) keeps this
             # request's lifecycle correlated end to end
             trace=msg.get("trace"),
+            tenant=msg.get("tenant"),
+            qos=msg.get("qos"),
         )
         self.log(f"submit {req.id} len={len(prompt)} max_new={params.max_new}")
         return {"type": "SUBMIT", "id": req.id}

@@ -136,6 +136,17 @@ RULES: Tuple[Rule, ...] = (
         scope="any",
     ),
     Rule(
+        name="alert.brownout",
+        summary="fleet degrading best-effort traffic (brownout ladder > normal)",
+        kind="threshold",
+        metric="fleet.brownout_level",
+        op=">",
+        threshold=0.0,
+        for_s=0.0,
+        severity="warning",
+        scope="fleet",
+    ),
+    Rule(
         name="alert.recompile",
         summary="jitted program retraced outside a reconfigure window",
         kind="sentinel",

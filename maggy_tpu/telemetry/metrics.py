@@ -53,6 +53,8 @@ GAUGES = frozenset(
         "serve.pages_shared",  # pages aliased by >1 request (prefix reuse)
         # serving fleet (serve/fleet/)
         "fleet.healthy_replicas",
+        "fleet.breaker_open",  # circuit breakers currently open (gray replicas)
+        "fleet.brownout_level",  # degradation ladder position (0=normal..3=shed)
         "serve.handoff_ms",  # prefill->decode KV handoff latency
         # autotuner (tune/)
         "tune.candidates",
@@ -87,6 +89,23 @@ COUNTERS = frozenset(
         "fleet.quarantined",
         "fleet.requeued",
         "fleet.routed",
+        # overload robustness (docs/fleet.md "QoS classes", docs/resilience.md
+        # "Gray failure & circuit breakers")
+        "fleet.brownout_clamped",  # best-effort dispatches with max_new clamped
+        "fleet.retry_deferred",  # requeues delayed by an exhausted retry budget
+        "fleet.breaker_opened",  # breaker transitions into OPEN (incl. re-opens)
+        "fleet.breaker_closed",  # half-open probes that verified recovery
+        # per-QoS-class scheduler accounting (serve/scheduler.py); the class
+        # tail is the closed qos set, spelled out so the lint sees every name
+        "serve.qos.admitted.premium",
+        "serve.qos.admitted.standard",
+        "serve.qos.admitted.best_effort",
+        "serve.qos.preempted.premium",
+        "serve.qos.preempted.standard",
+        "serve.qos.preempted.best_effort",
+        "serve.qos.quota_deferred.premium",
+        "serve.qos.quota_deferred.standard",
+        "serve.qos.quota_deferred.best_effort",
         "resilience.auto_resumes",
         "resilience.preempt_saves",
         "resilience.worker_deaths",
@@ -138,6 +157,7 @@ EVENTS = frozenset(
         "req.first_token",
         "req.finished",
         "req.preempted",  # pages freed, requeued ahead of fresh arrivals
+        "req.preempted_for_priority",  # victim lost its pages to a higher class
         # router-side hops (serve/fleet/router.py)
         "req.accepted",
         "req.dispatched",
@@ -173,6 +193,7 @@ DYNAMIC_PREFIXES = (
     "rpc_errors.",  # per-verb client failures (recorder.rpc)
     "rpc_frame_errors.",  # server frame hygiene (core/rpc.py)
     "train.comm_exposed_ms.",  # per-mesh-axis comm exposure (".data" ICI / ".slice" DCN)
+    "serve.qos.",  # per-class tails resolved from the closed qos set
 )
 
 BY_KIND = {
@@ -220,6 +241,8 @@ GAUGE_UNITS = {
     "serve.pages_free": "count",
     "serve.pages_shared": "count",
     "fleet.healthy_replicas": "count",
+    "fleet.breaker_open": "count",
+    "fleet.brownout_level": "count",
     "serve.handoff_ms": "ms",
     "tune.candidates": "count",
     "tune.pruned_oom": "count",

@@ -474,9 +474,12 @@ def test_fleet_burn_alert_fires_on_degraded_replica_and_resolves():
     panel = render_status(router._on_status({}))
     assert "ALERTS[" in panel and "ttft_slo_burn(!)" in panel
 
-    # recovery: the short window drains and the alert resolves
-    for _ in range(12):
+    # recovery: the short window drains, the burn alert resolves, and the
+    # brownout ladder (stepped up while the burn fired) walks back to 0 one
+    # level per recover_s — only then does alert.brownout clear too
+    for _ in range(30):
         advance()
+    assert router.brownout.level() == 0
     assert router.alerts.firing() == []
     flight = [r.get("name") for r in list(tel.flight)]
     assert ALERT_RESOLVED in flight

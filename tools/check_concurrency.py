@@ -84,6 +84,9 @@ CONSTRUCTORS = ("__init__", "__post_init__", "__del__")
 REQUIRED_MODELS: Tuple[Tuple[str, str, str], ...] = (
     (os.path.join("maggy_tpu", "serve", "scheduler.py"), "Scheduler", "_lock"),
     (os.path.join("maggy_tpu", "serve", "fleet", "router.py"), "Router", "_lock"),
+    (os.path.join("maggy_tpu", "serve", "fleet", "replica.py"), "CircuitBreaker", "_lock"),
+    (os.path.join("maggy_tpu", "serve", "qos.py"), "QuotaLedger", "_lock"),
+    (os.path.join("maggy_tpu", "serve", "loadgen.py"), "TrafficReplay", "_lock"),
     (os.path.join("maggy_tpu", "telemetry", "flightrec.py"), "Watchdog", "_lock"),
     (os.path.join("maggy_tpu", "core", "driver", "base.py"), "Driver", "lock"),
 )
