@@ -768,6 +768,75 @@ def bench_timeseries(quick: bool = False):
     }
 
 
+def bench_capacity(quick: bool = False):
+    """extra.capacity: capacity-observability overhead gate (ISSUE 16).
+
+    The capacity slice of the metrics tick — MemoryLedger reconcile+export,
+    page-heat buckets, fragmentation scan, prefix residency stats — rides
+    the same once-per-``interval_s`` cadence as extra.timeseries, so its
+    wall-clock share IS tick cost / tick interval: a deterministic model
+    with no A/B noise. Budget: <= 2% of every second."""
+    import time as _time
+
+    from maggy_tpu.serve.paging.allocator import BlockAllocator
+    from maggy_tpu.serve.prefix import PrefixIndex
+    from maggy_tpu.telemetry.memtrack import MemoryLedger
+    from maggy_tpu.telemetry.recorder import Telemetry
+    from maggy_tpu.telemetry.timeseries import SeriesStore
+
+    # a mid-size serving worker: 256-page pool, half resident with mixed
+    # heat, a ledger with the standard accounts, a few resident prefixes
+    alloc = BlockAllocator(num_pages=256, page_size=16)
+    held = [alloc.alloc(4) for _ in range(32)]
+    for i, pages in enumerate(held):
+        alloc.touch(pages, gen=i * 4)  # spread last-access over generations
+
+    ledger = MemoryLedger()
+    ledger.register("params", 512 << 20)
+    ledger.register("kv_pages", 256 << 20)
+    ledger.register("workspace", 64 << 20)
+    ledger.register("prefetch", 32 << 20)
+
+    index = PrefixIndex()
+    index.bytes_per_token = 4096
+    for slot in range(8):
+        index.insert(slot, [slot * 13 + t for t in range(24)], gen=slot)
+        index.match([slot * 13 + t for t in range(24)], gen=slot + 64)
+
+    tel = Telemetry(worker="bench-capacity")
+    store = SeriesStore()
+
+    n = 200 if quick else 600
+    base = 1_000_000.0
+    gen = 128
+    # warm allocation paths (first tick creates every Series object)
+    ledger.tick(store=store, telemetry=tel, now=base)
+    t0 = _time.perf_counter()
+    for tick in range(n):
+        now = base + 1.0 + tick  # 1 Hz, matching the scheduler's flush cadence
+        mem = ledger.tick(store=store, telemetry=tel, now=now)
+        heat = alloc.heat_buckets(gen + tick)
+        frag = alloc.fragmentation()
+        res = index.residency_stats(gen=gen + tick)
+        tel.gauge("serve.pages_hot", heat["hot"])
+        tel.gauge("serve.pages_warm", heat["warm"])
+        tel.gauge("serve.pages_cold", heat["cold"])
+        tel.gauge("serve.fragmentation", frag["frag_ratio"])
+        tel.gauge("serve.prefix_resident_bytes", res["resident_bytes"])
+        tel.gauge("serve.prefix_resident_count", res["resident_prefixes"])
+    tick_us = (_time.perf_counter() - t0) / n * 1e6
+    # one tick per interval_s of wall clock -> share of step/decode time
+    overhead_pct = tick_us / (store.interval_s * 1e6) * 100
+    return {
+        "tick_us": round(tick_us, 1),
+        "mem_headroom_pct": round(mem["headroom_pct"], 4),
+        "accounts": len(mem.get("accounts", {})),
+        "interval_s": store.interval_s,
+        "overhead_pct": round(overhead_pct, 3),
+        "within_budget": overhead_pct <= 2.0,
+    }
+
+
 def bench_fleet(quick: bool = False):
     """Serving fleet (maggy_tpu/serve/fleet, ISSUE 6): aggregate tok/s and
     TTFT p50/p95 at a FIXED offered load through the router with N=1 vs N=2
@@ -1370,6 +1439,7 @@ def write_run_summary(out) -> str:
     for block, key in (
         ("trace_overhead", "within_budget"),
         ("timeseries", "within_budget"),
+        ("capacity", "within_budget"),
         ("paging", "within_budget"),
         ("overlap", "within_budget"),
         ("qos", "no_cliff"),
@@ -1385,6 +1455,7 @@ def write_run_summary(out) -> str:
         "ttft_ms_p50": _get("serving", "ttft_ms_p50"),
         "ttft_ms_p95": _get("serving", "ttft_ms_p95"),
         "steps_per_sec": round(1000.0 / step_ms, 3) if step_ms else None,
+        "mem_headroom_pct": _get("capacity", "mem_headroom_pct"),
         "gates": gates,
         "cpu_fallback": extra.get("cpu_fallback"),
     }
@@ -1422,6 +1493,7 @@ def main():
         paging_stats = None
         overlap_stats = None
         timeseries_stats = None
+        capacity_stats = None
     else:
         asha_stats = bench_asha_trials_per_hour(quick=args.quick)
         try:
@@ -1476,6 +1548,10 @@ def main():
             timeseries_stats = bench_timeseries(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
             timeseries_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            capacity_stats = bench_capacity(quick=args.quick)
+        except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
+            capacity_stats = {"error": f"{type(e).__name__}: {e}"}
 
     def rnd(v, digits):
         return None if v is None else round(v, digits)
@@ -1509,6 +1585,7 @@ def main():
             "paging": paging_stats,
             "overlap": overlap_stats,
             "timeseries": timeseries_stats,
+            "capacity": capacity_stats,
             "tuned": tuned or None,
         },
     }

@@ -169,6 +169,16 @@ def _telemetry_lines(status: dict, width: int) -> list:
             )
         if "serve.handoff_ms" in g:
             parts.append(f"handoff {g['serve.handoff_ms']:.1f}ms")
+        # capacity (docs/observability.md "Capacity"): ledger headroom and
+        # page-heat buckets from the worker's metrics tick
+        if "mem.headroom_pct" in g:
+            parts.append(f"headroom {100 * g['mem.headroom_pct']:.0f}%")
+        if "serve.pages_hot" in g:
+            parts.append(
+                f"heat {g['serve.pages_hot']:.0f}"
+                f"/{g.get('serve.pages_warm', 0):.0f}"
+                f"/{g.get('serve.pages_cold', 0):.0f} h/w/c"
+            )
         if "fleet.healthy_replicas" in g:
             parts.append(f"healthy {g['fleet.healthy_replicas']:.0f}")
         c0 = snap.get("counters") or {}
@@ -223,6 +233,9 @@ def _telemetry_lines(status: dict, width: int) -> list:
         if "flightrec.dumps" in c:
             # a stall dump is a red flag worth surfacing on the panel
             parts.append(f"STALL-DUMPS {c['flightrec.dumps']}")
+        if "profcap.captures" in c:
+            # an alert armed a profile capture — evidence is on disk
+            parts.append(f"PROFCAP {c['profcap.captures']}")
         if not parts:
             continue
         tag = pid if pid == "driver" else f"w{pid}"
@@ -271,6 +284,59 @@ def _paging_parts(sv: dict) -> list:
             parts.append(f"{sv['pages_shared']} shared")
     if sv.get("preemptions"):
         parts.append(f"preempt {sv['preemptions']}")
+    return parts
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:,.0f}{unit}"
+        n /= 1024
+    return f"{n:,.0f}GB"  # unreachable; keeps the return type total
+
+
+def _capacity_parts(sv: dict) -> list:
+    """Capacity summary (docs/observability.md "Capacity"): HBM headroom
+    from the memory ledger, page-heat buckets, free-pool fragmentation,
+    resident-prefix KV, and profile-capture count. Single-engine SSTATS
+    nests ``memory``/``profcap``/``prefix_residency`` dicts and
+    ``paging.heat``; the fleet aggregate folds the same view (headroom =
+    tightest replica) under ``capacity``."""
+    parts = []
+    mem = sv.get("memory") or {}
+    cap = sv.get("capacity") or {}
+    paging = sv.get("paging") or {}
+    hp = mem.get("headroom_pct")
+    if hp is None:
+        hp = cap.get("headroom_pct")
+    if hp is not None:
+        parts.append(f"headroom {100 * float(hp):.0f}%")
+    if mem.get("unattributed"):
+        parts.append(f"unattrib {_fmt_bytes(mem['unattributed'])}")
+    heat = paging.get("heat") or {}
+    hot = heat.get("hot", cap.get("pages_hot"))
+    warm = heat.get("warm", cap.get("pages_warm"))
+    cold = heat.get("cold", cap.get("pages_cold"))
+    if hot or warm or cold:
+        parts.append(f"heat {hot or 0}/{warm or 0}/{cold or 0} h/w/c")
+    frag = (paging.get("fragmentation") or {}).get("frag_ratio")
+    if frag is None:
+        frag = cap.get("fragmentation")
+    if frag:
+        parts.append(f"frag {100 * float(frag):.0f}%")
+    resid = sv.get("prefix_residency") or {}
+    rb = resid.get("resident_bytes", cap.get("resident_bytes"))
+    rc = resid.get("resident_prefixes", cap.get("resident_prefixes"))
+    if rb:
+        parts.append(f"resident {rc or 0}pfx/{_fmt_bytes(rb)}")
+    top = resid.get("top") or cap.get("top_prefixes") or []
+    if top:
+        t = top[0]
+        parts.append(f"top {t.get('digest', '?')} x{t.get('hits', 0)}")
+    pc = sv.get("profcap") or {}
+    if pc.get("captures"):
+        parts.append(f"PROFCAP {pc['captures']}")
     return parts
 
 
@@ -445,6 +511,7 @@ def render_status(status: dict, width: int = 78) -> str:
                 f"({sv.get('prefix_tokens_saved', 0)} tok saved)"
             )
         agg.extend(_paging_parts(sv))
+        agg.extend(_capacity_parts(sv))
         agg.extend(_latency_parts(sv))
         lines.extend(_wrap_parts(agg, width))
         lines.extend(line[:width] for line in _autopilot_line(sv))
@@ -492,6 +559,7 @@ def render_status(status: dict, width: int = 78) -> str:
         if sv.get("tokens_per_sec"):
             parts.append(f"{sv['tokens_per_sec']:,.0f} tok/s")
         parts.extend(_paging_parts(sv))
+        parts.extend(_capacity_parts(sv))
         parts.extend(_latency_parts(sv))
         compiles = (sv.get("compile_counts") or {}).get("decode")
         if compiles is not None:

@@ -83,6 +83,7 @@ import numpy as np
 from maggy_tpu import telemetry
 from maggy_tpu.exceptions import BadArgumentsError
 from maggy_tpu.models.generate import init_cache, prefill
+from maggy_tpu.telemetry import memtrack
 from maggy_tpu.serve.paging import BlockAllocator, OutOfPagesError, PageTable
 from maggy_tpu.serve.prefix import PrefixIndex
 from maggy_tpu.serve.request import Request
@@ -220,6 +221,10 @@ class Engine:
         )
         self.pages_aliased = 0  # cumulative pages shared instead of copied
         self._last_page_gauges = None
+        # per-slot high-water page count while resident — the
+        # ``pages_held_peak`` figure trace attribution (v2) records per
+        # request; cleared with the slot in release()
+        self._peak_pages: Dict[int, int] = {}
         if self.paged:
             self.paged_model = Decoder(
                 dataclasses.replace(
@@ -279,6 +284,32 @@ class Engine:
 
         self.steps = 0
         self.tokens_out = 0
+
+        # capacity ledger: the engine's share of HBM, reconciled at 1 Hz by
+        # the scheduler's metrics tick (telemetry/memtrack.py)
+        self.memory = memtrack.MemoryLedger()
+        self._register_memory_accounts()
+
+    def _register_memory_accounts(self) -> None:
+        """(Re)register this engine's ledger accounts from live array sizes;
+        called at build and after every reconfigure so the figures track the
+        actual geometry (register is idempotent — no double counting)."""
+        self.memory.register("params", memtrack.array_bytes(self.params))
+        cache_bytes = memtrack.array_bytes(self.cache)
+        self.memory.register("kv_pages", cache_bytes)
+        self.memory.register(
+            "workspace",
+            memtrack.array_bytes(self.key_data)
+            + memtrack.array_bytes(self._zero_tokens),
+        )
+        # KV bytes one resident token pins, from the real cache geometry —
+        # sizes the prefix residency view (serve/prefix.py)
+        cap_tokens = (
+            self.num_pages * self.page_size
+            if self.paged
+            else self.slots.num_slots * self.max_seq_len
+        )
+        self.prefix_index.bytes_per_token = max(1, cache_bytes // max(1, cap_tokens))
 
     # ------------------------------------------------------------- jit bodies
 
@@ -623,7 +654,7 @@ class Engine:
             self.slots.admit(request, first, next_pos=plen, generated=gen0 + 1)
             == slot
         )
-        self.prefix_index.insert(slot, prompt)
+        self.prefix_index.insert(slot, prompt, gen=self.steps)
         self.tokens_out += 1
         self._record_compile_gauges()
         return slot, first
@@ -767,6 +798,8 @@ class Engine:
                 raise
             self.prefill_calls += 1
         self.page_table.assign(slot, page_list)
+        self.allocator.touch(page_list, self.steps)
+        self._peak_pages[slot] = len(page_list)
         self._push_page_table()
         self._pages_gauges()
         return tok
@@ -785,7 +818,7 @@ class Engine:
         logit that samples the request's first token."""
         if not self.prefix_reuse:
             return None
-        m = self.prefix_index.match(prompt)
+        m = self.prefix_index.match(prompt, gen=self.steps)
         if m is None:
             return None
         src, lcp = m
@@ -840,6 +873,7 @@ class Engine:
             self.allocator = BlockAllocator(self.num_pages, self.page_size)
             self.page_table = PageTable(B, self.pages_per_row)
             self._last_page_gauges = None
+        self._peak_pages = {}
         self.cache = init_cache(
             self._batch_model, jnp.zeros((B, 1), jnp.int32), mesh=self.mesh
         )
@@ -859,6 +893,7 @@ class Engine:
                 )
             )
         self._record_compile_gauges()
+        self._register_memory_accounts()
 
     def release(self, slot: int) -> Request:
         """Free a slot (EOS / max_new / cancel / deadline / preempt). THE
@@ -872,9 +907,17 @@ class Engine:
             pages = self.page_table.clear(slot)
             if pages:
                 self.allocator.release(pages)
+            self._peak_pages.pop(slot, None)
             self._pages_gauges()
         self.prefix_index.remove(slot)
         return self.slots.evict(slot)
+
+    def pages_held_peak(self, slot: int) -> int:
+        """High-water page count of the request resident in ``slot`` (0 in
+        dense mode). Read BEFORE :meth:`release` — the figure dies with the
+        slot; the scheduler stamps it on the request's finish event for
+        trace attribution (v2)."""
+        return self._peak_pages.get(slot, 0)
 
     # ------------------------------------------------------------ page growth
 
@@ -911,6 +954,12 @@ class Engine:
                     break
                 self.page_table.grow(s, page)
                 grew = True
+            held = self.page_table.count(s)
+            if held > self._peak_pages.get(s, 0):
+                self._peak_pages[s] = held
+            # heat stamp: an active row touches every page it holds this
+            # step (attention reads them all) — host-side dict stores only
+            self.allocator.touch(self.page_table.pages(s), self.steps)
         if grew:
             self._pages_gauges()
         return needy
@@ -1167,6 +1216,8 @@ class Engine:
                     self.allocator.release(fresh)
                     raise
                 self.page_table.assign(slot, fresh)
+                self.allocator.touch(fresh, self.steps)
+                self._peak_pages[slot] = len(fresh)
                 self._push_page_table()
                 self._pages_gauges()
             else:
@@ -1180,7 +1231,9 @@ class Engine:
                 )
         first = int(pack["first"])
         assert self.slots.admit(request, first) == slot
-        self.prefix_index.insert(slot, [int(t) for t in request.prompt])
+        self.prefix_index.insert(
+            slot, [int(t) for t in request.prompt], gen=self.steps
+        )
         self.tokens_out += 1
         self._record_compile_gauges()
         return slot, first
@@ -1207,13 +1260,17 @@ class Engine:
         }
 
     @property
-    def prefix_stats(self) -> Dict[str, int]:
+    def prefix_stats(self) -> Dict[str, Any]:
         """Reuse accounting for SSTATS/telemetry: hits, tokens the reuse
-        saved from prefill, and full prefills actually run."""
+        saved from prefill, full prefills actually run, and the residency
+        view (which prefixes pin how much KV, and how hot they are)."""
         return {
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_saved": self.prefix_tokens_saved,
             "prefill_calls": self.prefill_calls,
+            "prefix_residency": self.prefix_index.residency_stats(
+                gen=self.steps
+            ),
         }
 
     @property
@@ -1228,6 +1285,8 @@ class Engine:
             "max_pages_per_req": self.max_pages_per_req,
             "pages_aliased_total": self.pages_aliased,
             **self.allocator.stats(),
+            "fragmentation": self.allocator.fragmentation(),
+            "heat": self.allocator.heat_buckets(self.steps),
         }
 
     def set_max_pages_per_req(self, value: int) -> None:

@@ -85,6 +85,8 @@ TREND_SIGNALS = (
     "serve.tokens_per_sec",
     "serve.ttft_ms",
     "fleet.healthy_replicas",
+    "serve.fragmentation",
+    "mem.headroom_pct",
 )
 
 # router-side request states (downstream states pass through verbatim)
@@ -701,6 +703,20 @@ class Router:
         latency_dicts: Dict[str, List[Dict[str, Any]]] = {
             name: [] for name in LATENCY_SIGNALS
         }
+        # fleet capacity view (docs/observability.md "Capacity"): page heat
+        # and residency sum across replicas; headroom reports the MINIMUM
+        # (the tightest replica bounds what the fleet can still admit);
+        # top prefixes merge by cross-process digest
+        capacity: Dict[str, Any] = {
+            "pages_hot": 0,
+            "pages_warm": 0,
+            "pages_cold": 0,
+            "fragmentation": None,
+            "headroom_pct": None,
+            "resident_bytes": 0,
+            "resident_prefixes": 0,
+            "top_prefixes": [],
+        }
         for r in self.replicas:
             # in-process replicas answer fresh (lock-only, no sockets);
             # remote/dead ones fall back to the probe cache
@@ -744,6 +760,31 @@ class Router:
                 for k in ("pages_total", "pages_free", "pages_shared"):
                     agg[k] += paging.get(k, 0)
                 row["pages_free"] = paging.get("pages_free")
+                heat = paging.get("heat") or {}
+                capacity["pages_hot"] += int(heat.get("hot") or 0)
+                capacity["pages_warm"] += int(heat.get("warm") or 0)
+                capacity["pages_cold"] += int(heat.get("cold") or 0)
+                fr = (paging.get("fragmentation") or {}).get("frag_ratio")
+                if fr is not None:
+                    capacity["fragmentation"] = max(
+                        capacity["fragmentation"] or 0.0, float(fr)
+                    )
+            memory = stats.get("memory") or {}
+            hp = memory.get("headroom_pct")
+            row["headroom_pct"] = hp
+            if hp is not None:
+                capacity["headroom_pct"] = (
+                    float(hp)
+                    if capacity["headroom_pct"] is None
+                    else min(capacity["headroom_pct"], float(hp))
+                )
+            resid = stats.get("prefix_residency") or {}
+            capacity["resident_bytes"] += int(resid.get("resident_bytes") or 0)
+            capacity["resident_prefixes"] += int(
+                resid.get("resident_prefixes") or 0
+            )
+            for t in resid.get("top") or []:
+                capacity["top_prefixes"].append(dict(t, replica=r.index))
             for name, d in (stats.get("latency") or {}).items():
                 latency_dicts.setdefault(name, []).append(d)
         merged = {
@@ -791,6 +832,23 @@ class Router:
             }
         if self.autopilot is not None:
             agg["autopilot"] = self.autopilot.status()
+        # one residency row per distinct prefix digest: the same system
+        # prompt resident on three replicas is ONE fleet anchor pinning
+        # 3x the bytes, not three anchors
+        by_digest: Dict[str, Dict[str, Any]] = {}
+        for t in capacity["top_prefixes"]:
+            d = by_digest.setdefault(
+                str(t.get("digest")),
+                {"digest": t.get("digest"), "bytes": 0, "hits": 0, "replicas": []},
+            )
+            d["bytes"] += int(t.get("bytes") or 0)
+            d["hits"] += int(t.get("hits") or 0)
+            d["replicas"].append(t.get("replica"))
+        capacity["top_prefixes"] = sorted(
+            by_digest.values(),
+            key=lambda d: (-d["hits"], -d["bytes"], str(d["digest"])),
+        )[:4]
+        agg["capacity"] = capacity
         # ALERTS surface: fleet-scope rules plus whatever each replica's
         # worker-scope evaluator reports in its SSTATS
         alerts = list(self.alerts.firing())
@@ -854,6 +912,15 @@ class Router:
             "fleet.healthy_replicas": float(len(self._healthy())),
         }
         tokens_per_sec = 0.0
+        # fleet capacity accumulators: heat/residency sum across replicas;
+        # headroom takes the MINIMUM — the tightest replica is the one the
+        # next admission can actually land on
+        heat_sum = {"hot": 0.0, "warm": 0.0, "cold": 0.0}
+        have_heat = False
+        frag_max = None
+        resid_bytes = resid_count = 0.0
+        have_resid = False
+        headroom_min = None
         for idx, stats in cache.items():
             if not stats:
                 continue
@@ -873,6 +940,11 @@ class Router:
                 slo_miss_sum += int(stats.get("slo_miss") or 0)
                 counters["serve.slo_ok"] = stats.get("slo_ok")
                 counters["serve.slo_miss"] = stats.get("slo_miss")
+            paging = stats.get("paging") or {}
+            heat = paging.get("heat") or {}
+            frag = paging.get("fragmentation") or {}
+            resid = stats.get("prefix_residency") or {}
+            memory = stats.get("memory") or {}
             store.ingest(
                 now,
                 gauges={
@@ -880,15 +952,49 @@ class Router:
                     "serve.active_slots": stats.get("active_slots"),
                     "serve.tokens_per_sec": stats.get("tokens_per_sec"),
                     "serve.ttft_ms": stats.get("ttft_ms_p95"),
-                    "serve.pages_free": (stats.get("paging") or {}).get("pages_free"),
+                    "serve.pages_free": paging.get("pages_free"),
+                    "serve.pages_hot": heat.get("hot"),
+                    "serve.pages_warm": heat.get("warm"),
+                    "serve.pages_cold": heat.get("cold"),
+                    "serve.fragmentation": frag.get("frag_ratio"),
+                    "serve.prefix_resident_bytes": resid.get("resident_bytes"),
+                    "serve.prefix_resident_count": resid.get("resident_prefixes"),
+                    "mem.headroom_pct": memory.get("headroom_pct"),
                 },
                 counters=counters,
                 hists=hists,
             )
+            if heat:
+                have_heat = True
+                for k in heat_sum:
+                    heat_sum[k] += float(heat.get(k) or 0.0)
+            if frag.get("frag_ratio") is not None:
+                f = float(frag["frag_ratio"])
+                frag_max = f if frag_max is None else max(frag_max, f)
+            if resid:
+                have_resid = True
+                resid_bytes += float(resid.get("resident_bytes") or 0.0)
+                resid_count += float(resid.get("resident_prefixes") or 0.0)
+            hp = memory.get("headroom_pct")
+            if hp is not None:
+                headroom_min = (
+                    float(hp) if headroom_min is None else min(headroom_min, float(hp))
+                )
             tokens_per_sec += float(stats.get("tokens_per_sec") or 0.0)
             for name, d in (stats.get("latency") or {}).items():
                 latency_all.setdefault(name, []).append(d)
         fleet_gauges["serve.tokens_per_sec"] = round(tokens_per_sec, 2)
+        if have_heat:
+            fleet_gauges["serve.pages_hot"] = heat_sum["hot"]
+            fleet_gauges["serve.pages_warm"] = heat_sum["warm"]
+            fleet_gauges["serve.pages_cold"] = heat_sum["cold"]
+        if frag_max is not None:
+            fleet_gauges["serve.fragmentation"] = frag_max
+        if have_resid:
+            fleet_gauges["serve.prefix_resident_bytes"] = resid_bytes
+            fleet_gauges["serve.prefix_resident_count"] = resid_count
+        if headroom_min is not None:
+            fleet_gauges["mem.headroom_pct"] = headroom_min
         merged_hists: Dict[str, Dict[str, Any]] = {}
         for name, ds in latency_all.items():
             h = merge_dicts(ds)

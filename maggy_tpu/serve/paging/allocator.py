@@ -23,7 +23,7 @@ is never double-freed, never on the free list while referenced, and
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -57,6 +57,12 @@ class BlockAllocator:
         # locality keeps the hot working set small)
         self._free: List[int] = list(range(self.num_pages - 1, SCRATCH_PAGE, -1))
         self._refs: Dict[int, int] = {}
+        # page -> last-access generation (engine decode-step clock), stamped
+        # host-side by ``touch`` on the admit/prepare paths — the heat signal
+        # the tiering eviction ranking reads. Entries exist only for
+        # referenced pages; a page freed is a page forgotten.
+        # guarded-by: the engine lock (all allocator mutation already is)
+        self._last_access: Dict[int, int] = {}
         # cumulative counters (monotonic; bench/stats)
         self.allocs = 0
         self.shares = 0
@@ -121,11 +127,82 @@ class BlockAllocator:
                 raise ValueError(f"double free of page {p}")
             if refs == 1:
                 del self._refs[p]
+                self._last_access.pop(p, None)
                 self._free.append(p)
                 freed += 1
             else:
                 self._refs[p] = refs - 1
         return freed
+
+    # ------------------------------------------------------------------- heat
+
+    def touch(self, pages: Sequence[int], gen: int) -> None:
+        """Stamp ``pages`` as accessed at generation ``gen`` (the engine's
+        decode-step counter). Host dict stores only — zero device cost on
+        the admit/prepare hot paths. Touching a free page is ignored (a
+        release can race a stale caller list by design)."""
+        gen = int(gen)
+        refs = self._refs
+        la = self._last_access
+        for p in pages:
+            p = int(p)
+            if p in refs:
+                la[p] = gen
+
+    def heat_buckets(
+        self, gen: int, hot_age: int = 8, warm_age: int = 64
+    ) -> Dict[str, int]:
+        """Classify every referenced page by last-access age in generations:
+        ``age <= hot_age`` hot, ``<= warm_age`` warm, else cold. Pages
+        allocated but never touched count as cold (no stamp == no access)."""
+        gen = int(gen)
+        hot = warm = cold = 0
+        la = self._last_access
+        for p in self._refs:
+            last = la.get(p)
+            age = gen - last if last is not None else warm_age + 1
+            if age <= hot_age:
+                hot += 1
+            elif age <= warm_age:
+                warm += 1
+            else:
+                cold += 1
+        return {"hot": hot, "warm": warm, "cold": cold}
+
+    def coldest(self, n: Optional[int] = None) -> List[int]:
+        """Referenced pages ranked coldest-first (oldest last-access
+        generation; never-touched pages first of all) — the eviction-candidate
+        ordering the KV-tiering PR consumes as-is. Ties break on page id for
+        determinism."""
+        la = self._last_access
+        ranked = sorted(self._refs, key=lambda p: (la.get(p, -1), p))
+        return ranked if n is None else ranked[: int(n)]
+
+    # ---------------------------------------------------------- fragmentation
+
+    def fragmentation(self) -> Dict[str, float]:
+        """Free-run-length distribution: how contiguous the free pool is.
+        ``frag_ratio`` is 0.0 when all free pages form one run (or none are
+        free) and approaches 1.0 as the free space shatters into single-page
+        runs — a threshold alert rule watches this via ``serve.fragmentation``."""
+        free = sorted(self._free)
+        if not free:
+            return {"free_runs": 0, "largest_run": 0, "frag_ratio": 0.0}
+        runs = 1
+        largest = cur = 1
+        for prev, nxt in zip(free, free[1:]):
+            if nxt == prev + 1:
+                cur += 1
+            else:
+                runs += 1
+                cur = 1
+            if cur > largest:
+                largest = cur
+        return {
+            "free_runs": runs,
+            "largest_run": largest,
+            "frag_ratio": round(1.0 - largest / len(free), 4),
+        }
 
     def check_invariants(self) -> None:
         free = set(self._free)
@@ -134,6 +211,13 @@ class BlockAllocator:
         assert not (free & set(self._refs)), "page both free and referenced"
         assert len(free) + len(self._refs) == self.pages_total
         assert all(n >= 1 for n in self._refs.values())
+        assert set(self._last_access) <= set(self._refs), (
+            "heat stamp on a non-referenced page"
+        )
+        frag = self.fragmentation()
+        assert (frag["largest_run"] == 0) == (not self._free)
+        assert frag["largest_run"] <= len(self._free)
+        assert 0.0 <= frag["frag_ratio"] <= 1.0
 
     def stats(self) -> Dict[str, int]:
         return {

@@ -52,6 +52,7 @@ from maggy_tpu.serve.qos import (
 from maggy_tpu.serve.request import Request, SamplingParams
 from maggy_tpu.telemetry import flightrec, timeseries, tracing
 from maggy_tpu.telemetry.alerts import AlertEvaluator, RecompileSentinel
+from maggy_tpu.telemetry.profcap import ProfileCapture
 from maggy_tpu.telemetry.histogram import LatencyHistogram
 
 # the latency signals the scheduler aggregates (histogram per signal);
@@ -158,6 +159,15 @@ class Scheduler:
         self.sentinel = RecompileSentinel(
             self.metrics, self.telemetry, scope="worker", steady=("decode", "admit")
         )
+        # capacity observability (docs/observability.md "Capacity"): the
+        # engine's memory ledger reconciles on the same tick, and a watched
+        # critical alert arms a bounded profile capture beside the
+        # flight-recorder dumps (telemetry/profcap.py)
+        self.memory = engine.memory
+        self.profcap = ProfileCapture()
+        # last ticked headroom, stamped on admission events for trace
+        # attribution (headroom_at_admit); loop thread writes, loop reads
+        self._last_headroom_pct: Optional[float] = None
 
     # ------------------------------------------------------------- public API
     # (called from RPC handler threads; must not block on device work)
@@ -312,8 +322,27 @@ class Scheduler:
 
     def _metrics_tick(self, now: float, wd=None) -> None:
         """One observability tick (loop thread, ~1 Hz with the flush):
-        sample the recorder into the series rings, ingest the SLO counters,
-        feed compile counts to the sentinel, run the alert rules."""
+        reconcile the capacity ledger, sample the recorder into the series
+        rings, ingest the SLO counters, feed compile counts to the
+        sentinel, run the alert rules, and hand the alert transitions to
+        the profile-capture controller."""
+        # capacity gauges go out BEFORE the sample so they land in this
+        # tick's series points (heat/fragmentation ride the recorder; the
+        # ledger ingests its mem.* series and burn counters directly)
+        eng = self.engine
+        tel = self.telemetry
+        mem = self.memory.tick(store=self.metrics, telemetry=tel, now=now)
+        self._last_headroom_pct = mem.get("headroom_pct") if mem else None
+        if eng.paged:
+            heat = eng.allocator.heat_buckets(eng.steps)
+            frag = eng.allocator.fragmentation()
+            tel.gauge("serve.pages_hot", heat["hot"])
+            tel.gauge("serve.pages_warm", heat["warm"])
+            tel.gauge("serve.pages_cold", heat["cold"])
+            tel.gauge("serve.fragmentation", frag["frag_ratio"])
+        res = eng.prefix_index.residency_stats(gen=eng.steps)
+        tel.gauge("serve.prefix_resident_bytes", res["resident_bytes"])
+        tel.gauge("serve.prefix_resident_count", res["resident_prefixes"])
         self.metrics.sample(self.telemetry, now)
         if self.slo_ttft_ms is not None:
             with self._lock:
@@ -326,7 +355,10 @@ class Scheduler:
                 },
             )
         self.sentinel.observe(self.engine.compile_counts, now, watchdog=wd)
-        self.alerts.evaluate(now, watchdog=wd)
+        transitions = self.alerts.evaluate(now, watchdog=wd)
+        if self.profcap.dump_dir is None and getattr(wd, "dump_dir", None):
+            self.profcap.configure(dump_dir=wd.dump_dir)
+        self.profcap.tick(transitions, now=now)
         self.telemetry.gauge(
             "alerts.firing", len(self.alerts.firing()) + len(self.sentinel.firing())
         )
@@ -363,6 +395,10 @@ class Scheduler:
                 "compile_counts": engine.compile_counts,
                 "paging": engine.paging_stats,
                 "preemptions": self.preemptions,
+                # capacity view: ledger reconciliation + profile-capture
+                # controller state (docs/observability.md "Capacity")
+                "memory": self.memory.snapshot(),
+                "profcap": self.profcap.snapshot(),
                 # per-class QoS view (docs/fleet.md "QoS classes"): queue
                 # depths, lifetime admission/preempt/defer counts, and the
                 # quota ledger's windowed token shares
@@ -448,9 +484,20 @@ class Scheduler:
         if req.tpot_ms is not None:
             self._hist["tpot_ms"].observe(req.tpot_ms)
             tel.histogram("serve.tpot_ms", req.tpot_ms)
+        # trace attribution v2: the request's high-water page count rides
+        # the finish event (the slot is still resident here — release runs
+        # after the finish on every exit path)
+        peak = None
+        eng = self.engine
+        if eng.paged:
+            for s in eng.slots.active_slots():
+                if eng.slots.get(s).request is req:
+                    peak = eng.pages_held_peak(s)
+                    break
         tel.event(
             "req.finished", trace=req.trace, rid=req.id, state=state,
             n_tokens=len(req.tokens), e2e_ms=req.e2e_ms,
+            pages_held_peak=peak,
         )
 
     def _emit(self, req: Request, token: int, now: float) -> bool:  # guarded-by: _lock
@@ -549,6 +596,7 @@ class Scheduler:
             tel.event(
                 "req.prefix_admitted" if prefix_hit else "req.admitted",
                 trace=req.trace, rid=req.id, queue_wait_ms=wait_ms,
+                headroom_at_admit=self._last_headroom_pct,
             )
             pack, req.prefilled = req.prefilled, None
             admitted = False

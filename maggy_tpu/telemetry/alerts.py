@@ -136,6 +136,32 @@ RULES: Tuple[Rule, ...] = (
         scope="any",
     ),
     Rule(
+        name="alert.hbm_headroom",
+        summary="HBM headroom below the low-water mark; capacity budget burning",
+        kind="burn_rate",
+        # counter pair synthesized by the memory ledger's reconcile tick
+        # (telemetry/memtrack.py): a tick with headroom under the low-water
+        # mark counts as a miss — the multi-window burn shape then gives
+        # sustained pressure a fast page and a one-tick dip nothing
+        ok_metric="mem.headroom_ok",
+        miss_metric="mem.headroom_miss",
+        objective=0.90,
+        windows=((30.0, 2.0), (5.0, 2.0)),
+        severity="critical",
+        scope="worker",
+    ),
+    Rule(
+        name="alert.fragmentation",
+        summary="paged-KV free pool fragmented; large admissions may thrash",
+        kind="threshold",
+        metric="serve.fragmentation",
+        op=">",
+        threshold=0.5,
+        for_s=3.0,
+        severity="warning",
+        scope="worker",
+    ),
+    Rule(
         name="alert.brownout",
         summary="fleet degrading best-effort traffic (brownout ladder > normal)",
         kind="threshold",

@@ -48,8 +48,12 @@ import os
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 # bump ONLY with an additive change note in docs/observability.md; external
-# tooling keys on this
-SCHEMA = "maggy-tpu.trace-attribution.v1"
+# tooling keys on this.
+# v2 (additive): rows carry the capacity attrs stamped on the lifecycle
+# events — ``pages_held_peak`` (req.finished) and ``headroom_at_admit``
+# (req.admitted / req.prefix_admitted). v1 JSONL without those attrs still
+# reads fine: the fields are simply None.
+SCHEMA = "maggy-tpu.trace-attribution.v2"
 
 # (previous milestone, this milestone) -> attribution bucket; gaps between
 # consecutive lifecycle events not named here land in "other"
@@ -167,6 +171,9 @@ def attribute_requests(records: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]
                 "e2e_ms": (float(events[-1]["ts"]) - float(events[0]["ts"])) * 1e3,
                 "hops": sum(1 for e in events if e["name"] == "req.requeued"),
                 "components": components,
+                # schema v2 capacity fields (None on v1 JSONL)
+                "pages_held_peak": attrs.get("pages_held_peak"),
+                "headroom_at_admit": attrs.get("headroom_at_admit"),
             }
         )
     return out

@@ -51,6 +51,21 @@ GAUGES = frozenset(
         # paged KV cache (serve/paging/, docs/serving.md "Paged KV cache")
         "serve.pages_free",  # allocatable pages left in the pool
         "serve.pages_shared",  # pages aliased by >1 request (prefix reuse)
+        # KV page heat + fragmentation (serve/paging/allocator.py heat
+        # stamps; docs/observability.md "Capacity")
+        "serve.pages_hot",  # pages accessed within the hot generation window
+        "serve.pages_warm",  # pages idle past hot but inside warm
+        "serve.pages_cold",  # pages idle past the warm window (eviction candidates)
+        "serve.fragmentation",  # free-pool frag ratio (0=one run, ->1 shattered)
+        # prefix residency (serve/prefix.py residency_stats)
+        "serve.prefix_resident_bytes",  # KV bytes pinned by resident prompts
+        "serve.prefix_resident_count",  # resident prompts in the prefix index
+        # device memory ledger (telemetry/memtrack.py; per-account gauges
+        # ride the mem.account. dynamic prefix)
+        "mem.hbm_used",  # reported device bytes in use (sim on CPU)
+        "mem.hbm_free",  # pool limit minus used
+        "mem.headroom_pct",  # free/limit — the autoscaler's capacity signal
+        "mem.unattributed",  # reported-used minus the account sum
         # serving fleet (serve/fleet/)
         "fleet.healthy_replicas",
         "fleet.breaker_open",  # circuit breakers currently open (gray replicas)
@@ -128,6 +143,11 @@ COUNTERS = frozenset(
         # rules and metrics_query resolve them with units
         "serve.slo_ok",  # requests that met the TTFT SLO
         "serve.slo_miss",  # requests that missed the TTFT SLO
+        # series-only headroom low-water tick counters (telemetry/memtrack.py):
+        # the counter pair alert.hbm_headroom's multi-window burn reads
+        "mem.headroom_ok",  # ledger ticks with headroom above the low-water mark
+        "mem.headroom_miss",  # ledger ticks under it (capacity budget burning)
+        "profcap.captures",  # alert-triggered profile captures written (telemetry/profcap.py)
         # autopilot online controller (autopilot/controller.py)
         "autopilot.diagnoses",  # windows classified
         "autopilot.retunes",  # guarded moves committed
@@ -194,6 +214,7 @@ DYNAMIC_PREFIXES = (
     "rpc_frame_errors.",  # server frame hygiene (core/rpc.py)
     "train.comm_exposed_ms.",  # per-mesh-axis comm exposure (".data" ICI / ".slice" DCN)
     "serve.qos.",  # per-class tails resolved from the closed qos set
+    "mem.account.",  # per-account ledger gauges (telemetry/memtrack.py)
 )
 
 BY_KIND = {
@@ -240,6 +261,16 @@ GAUGE_UNITS = {
     "serve.prefill_retraces": "count",
     "serve.pages_free": "count",
     "serve.pages_shared": "count",
+    "serve.pages_hot": "count",
+    "serve.pages_warm": "count",
+    "serve.pages_cold": "count",
+    "serve.fragmentation": "ratio",
+    "serve.prefix_resident_bytes": "bytes",
+    "serve.prefix_resident_count": "count",
+    "mem.hbm_used": "bytes",
+    "mem.hbm_free": "bytes",
+    "mem.headroom_pct": "ratio",
+    "mem.unattributed": "bytes",
     "fleet.healthy_replicas": "count",
     "fleet.breaker_open": "count",
     "fleet.brownout_level": "count",

@@ -95,6 +95,7 @@ class DevicePrefetcher:
         depth: int = 2,
         max_items: Optional[int] = None,
         telemetry_recorder=None,
+        ledger=None,
     ):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
@@ -103,6 +104,11 @@ class DevicePrefetcher:
         self.depth = depth
         self.max_items = max_items
         self._tel = telemetry_recorder or telemetry.get()
+        # optional memtrack.MemoryLedger: its "prefetch" account follows the
+        # staged-batch bytes (per-batch size x queue occupancy), sized once
+        # from the first consumed batch — fit batches are shape-stable
+        self._ledger = ledger
+        self._batch_bytes: Optional[int] = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -156,6 +162,14 @@ class DevicePrefetcher:
             self._terminal = item
             raise item.exc
         self.consumed += 1
+        if self._ledger is not None:
+            if self._batch_bytes is None:
+                from maggy_tpu.telemetry import memtrack
+
+                self._batch_bytes = memtrack.array_bytes(item)
+            self._ledger.register(
+                "prefetch", self._batch_bytes * (self._queue.qsize() + 1)
+            )
         return item
 
     # -------------------------------------------------------------------- tune
@@ -198,6 +212,8 @@ class DevicePrefetcher:
 
     def close(self) -> None:
         """Stop the producer and drop buffered batches. Idempotent."""
+        if self._ledger is not None:
+            self._ledger.unregister("prefetch")
         self._stop.set()
         try:
             while True:

@@ -1341,6 +1341,15 @@ class Trainer:
         profiling = False
         prof_start = min(profile_steps[0], max(0, num_steps - 2))
         prof_stop = min(profile_steps[1], num_steps - 1)
+        # capacity ledger (docs/observability.md "Capacity"): the training
+        # tier's HBM accounts — params, optimizer state (the ZeRO shards on
+        # a sharded mesh), and the prefetcher's staged batches — reconciled
+        # against reported device memory on the series-sample cadence
+        from maggy_tpu.telemetry import memtrack as _memtrack
+
+        ledger = _memtrack.MemoryLedger()
+        ledger.register("params", _memtrack.array_bytes(state.params))
+        ledger.register("optimizer", _memtrack.array_bytes(state.opt_state))
         depth = _prefetch_depth(prefetch)
         prefetcher = None
         if depth > 0 and num_steps > 0:
@@ -1352,6 +1361,7 @@ class Trainer:
                 depth=depth,
                 max_items=num_steps,
                 telemetry_recorder=tel,
+                ledger=ledger,
             )
         window = max(0, int(metrics_window))
         # autopilot: an in-loop controller fed one sample per step; its
@@ -1467,7 +1477,17 @@ class Trainer:
                     sentinel.expect("train_step")
                     self._expect_recompile = False
                 sentinel.observe(self.compile_counts, watchdog=wd)
-                ts_store.maybe_sample(tel)
+                if ts_store.maybe_sample(tel):
+                    # same ~1 s cadence as the series sample: reconcile the
+                    # HBM accounts and export headroom (params/optimizer
+                    # re-read so adopted/replaced state stays honest)
+                    ledger.register(
+                        "params", _memtrack.array_bytes(state.params)
+                    )
+                    ledger.register(
+                        "optimizer", _memtrack.array_bytes(state.opt_state)
+                    )
+                    ledger.tick(store=ts_store, telemetry=tel, now=time.time())
                 # lagged metrics window: refs sit here `window` steps before
                 # anything host-reads them, so broadcasts touch only results
                 # the device has long finished — never the dispatch frontier

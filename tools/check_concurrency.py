@@ -88,6 +88,8 @@ REQUIRED_MODELS: Tuple[Tuple[str, str, str], ...] = (
     (os.path.join("maggy_tpu", "serve", "qos.py"), "QuotaLedger", "_lock"),
     (os.path.join("maggy_tpu", "serve", "loadgen.py"), "TrafficReplay", "_lock"),
     (os.path.join("maggy_tpu", "telemetry", "flightrec.py"), "Watchdog", "_lock"),
+    (os.path.join("maggy_tpu", "telemetry", "memtrack.py"), "MemoryLedger", "_lock"),
+    (os.path.join("maggy_tpu", "telemetry", "profcap.py"), "ProfileCapture", "_lock"),
     (os.path.join("maggy_tpu", "core", "driver", "base.py"), "Driver", "lock"),
 )
 

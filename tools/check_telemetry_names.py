@@ -133,6 +133,47 @@ def check_alert_registry(alerts, registry) -> List[str]:
     return out
 
 
+# the capacity-alerting contract (docs/observability.md "Capacity"): these
+# rules must exist with exactly these series wirings. They are profcap's
+# default watch list — deleting or re-pointing one silently disarms
+# alert-triggered profile capture, so the wiring is pinned here.
+CAPACITY_RULES = {
+    "alert.hbm_headroom": {
+        "kind": "burn_rate",
+        "ok_metric": "mem.headroom_ok",
+        "miss_metric": "mem.headroom_miss",
+    },
+    "alert.fragmentation": {
+        "kind": "threshold",
+        "metric": "serve.fragmentation",
+    },
+}
+
+
+def check_capacity_rules(alerts) -> List[str]:
+    """The two capacity rules exist and read the series the exporters
+    actually write (MemoryLedger's counter pair, the scheduler tick's
+    fragmentation gauge)."""
+    out: List[str] = []
+    by_name = {r.name: r for r in getattr(alerts, "RULES", ())}
+    for name, want in CAPACITY_RULES.items():
+        r = by_name.get(name)
+        if r is None:
+            out.append(f"capacity rule {name!r} missing from alerts.RULES")
+            continue
+        for field, expect in want.items():
+            got = getattr(r, field, None)
+            if got != expect:
+                out.append(
+                    f"alerts.RULES[{name!r}]: {field}={got!r}, expected {expect!r}"
+                )
+        if want.get("kind") == "burn_rate" and len(getattr(r, "windows", ()) or ()) < 2:
+            out.append(
+                f"alerts.RULES[{name!r}]: multi-window burn rule needs >= 2 windows"
+            )
+    return out
+
+
 def _receiver_is_telemetry(expr: ast.AST) -> bool:
     """True when the call receiver plausibly is a telemetry recorder: some
     identifier in its chain contains 'tel'. Keeps ``"abc".count("a")`` and
@@ -225,6 +266,9 @@ def main(argv=None) -> int:
     alerts_path = os.path.join(repo, "maggy_tpu", "telemetry", "alerts.py")
     violations.extend(
         (alerts_path, 0, what) for what in check_alert_registry(alerts, registry)
+    )
+    violations.extend(
+        (alerts_path, 0, what) for what in check_capacity_rules(alerts)
     )
     alert_names = {r.name for r in alerts.RULES} | {
         alerts.ALERT_FIRING,

@@ -66,12 +66,37 @@ def query(
     """One windowed query over one or many stores (many = fleet merge)."""
     out: dict = {"name": name, "window_s": window_s}
     if len(stores) > 1:
-        if q is None:
-            raise SystemExit("--merge requires --q (histogram merge only)")
         out["merged_from"] = len(stores)
-        out[f"p{int(q * 100)}"] = merge_windowed_percentile(
-            stores, name, q, window_s, now
-        )
+        if q is not None:
+            out[f"p{int(q * 100)}"] = merge_windowed_percentile(
+                stores, name, q, window_s, now
+            )
+            return out
+        # gauge/counter fleet merge — the offline reproduction of the
+        # router's capacity aggregation: gauges sum latest values (and
+        # report min/max, so headroom-style "tightest replica" reads are
+        # one invocation), counters sum windowed deltas/rates
+        series = [s.get(name) for s in stores]
+        series = [s for s in series if s is not None]
+        if not series:
+            raise SystemExit(f"no series {name!r} in any snapshot (try --list)")
+        kind = series[0].kind
+        out["kind"] = kind
+        if kind == "hist":
+            raise SystemExit("--merge on a hist series requires --q")
+        if kind == "counter" or rate:
+            deltas = [s.delta(window_s, now) for s in series]
+            deltas = [d for d in deltas if d is not None]
+            out["delta"] = sum(deltas) if deltas else None
+            rates = [s.rate(window_s, now) for s in series]
+            rates = [r for r in rates if r is not None]
+            out["rate_per_s"] = sum(rates) if rates else None
+        else:
+            latests = [s.latest() for s in series]
+            vals = [v for v in (lt[1] for lt in latests if lt is not None)]
+            out["sum"] = sum(vals) if vals else None
+            out["min"] = min(vals) if vals else None
+            out["max"] = max(vals) if vals else None
         return out
     s = stores[0].get(name)
     if s is None:
