@@ -1032,6 +1032,175 @@ def bench_qos(quick: bool = False):
     }
 
 
+def bench_fleetkv(quick: bool = False):
+    """extra.fleetkv: fleet-global KV gate (ISSUE 18). The same seeded
+    prefix-heavy workload (6 long stems cycling over 2 small replicas, more
+    stems than either device pool holds) runs twice: affinity-blind with
+    the host tier off, then with prefix-affinity routing + the host-DRAM
+    page tier on. The gate: prefill compute (engine prefill_tokens summed
+    over replicas) drops >= 2x with affinity+tiering at no worse SLO
+    attainment (within 0.05), and a spilled stream swapped back in resumes
+    byte-identically. CPU-safe (tiny decoder, in-process replicas)."""
+    import jax
+    import jax.numpy as jnp
+
+    from maggy_tpu.models import Decoder, DecoderConfig
+    from maggy_tpu.parallel.sharding import unbox
+    from maggy_tpu.serve import Engine, Request, SamplingParams, ServeClient
+    from maggy_tpu.serve.fleet import ReplicaSpec, RouterConfig, launch_fleet
+    from maggy_tpu.serve.qos import STANDARD
+
+    cfg = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+    params = unbox(
+        Decoder(cfg).init(jax.random.key(3), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    stems = [
+        [(7 * i + 3 * j) % 97 + 2 for j in range(24)] for i in range(6)
+    ]
+    rounds = 3 if quick else 5
+
+    def run(assisted: bool):
+        router = launch_fleet(
+            ReplicaSpec(
+                cfg, params, num_slots=3, paged=True, page_size=16,
+                num_pages=12, tier=assisted, tier_host_pages=64,
+            ),
+            replicas=2,
+            config=RouterConfig(
+                slo_ttft_ms=2500.0,
+                admission="queue",
+                affinity_weight_ms=50.0 if assisted else 0.0,
+            ),
+        )
+        host, port = router.start(host="127.0.0.1")
+
+        def prefill_tokens():
+            return sum(
+                r.server.scheduler.engine.prefill_tokens
+                for r in router.replicas
+                if r.server is not None
+            )
+
+        try:
+            with ServeClient((host, port), router.secret) as client:
+                # warm every bucket shape on both replicas so first-use
+                # compiles never count as prefill-compute or SLO misses
+                for i in range(4):
+                    client.generate(list(range(1 + i, 29 + i)), max_new=2,
+                                    qos=STANDARD, timeout=240)
+                # rounds 0-1 are warm rounds for BOTH runs: round 0 seeds
+                # residency (full prefills, spills on release), round 1 is
+                # the first affinity-routed wave and compiles the
+                # suffix-bucket swap-in programs — so first-use compiles
+                # never masquerade as prefill compute or SLO misses;
+                # measurement (prefill tokens + client-side TTFT
+                # attainment) covers rounds 2..N+1 only
+                base = None
+                done = 0
+                ttfts = []
+                for rnd_i in range(rounds + 2):
+                    rids = [
+                        client.submit(stem + [200 + rnd_i, 201, 202, 203],
+                                      max_new=4, qos=STANDARD)
+                        for stem in stems
+                    ]
+                    for rid in rids:
+                        out = client.result(rid, timeout=120)
+                        if rnd_i < 2:
+                            continue
+                        done += out.get("state") == "done"
+                        if out.get("ttft_ms") is not None:
+                            ttfts.append(float(out["ttft_ms"]))
+                    if rnd_i == 1:
+                        base = prefill_tokens()
+                    # one metrics tick between rounds so each replica's
+                    # residency sample lands in the fleet prefix map
+                    # before the next wave routes
+                    time.sleep(1.2)
+                stats = client.stats()
+            spent = prefill_tokens() - base
+            fills = sum(
+                (r.server.scheduler.engine.tier_stats or {}).get("fills", 0)
+                for r in router.replicas
+                if r.server is not None
+            )
+        finally:
+            router.stop()
+        return {
+            "done": done,
+            "prefill_tokens": spent,
+            "slo_attainment": (
+                sum(t <= 2500.0 for t in ttfts) / len(ttfts)
+                if ttfts
+                else None
+            ),
+            "ttft_p95_ms": (
+                sorted(ttfts)[max(0, int(0.95 * len(ttfts)) - 1)]
+                if ttfts
+                else None
+            ),
+            "affinity_hits": stats["routing"].get("affinity_hits", 0),
+            "tier_fills": fills,
+        }
+
+    blind = run(assisted=False)
+    assisted = run(assisted=True)
+
+    # byte-identity subcheck: spill -> swap-in resumes the exact stream a
+    # never-preempted engine produces (sampled, seeded — not just greedy)
+    prompt = list(range(3, 40))
+    sp = SamplingParams(max_new=8, temperature=0.7, seed=5)
+
+    def free_run():
+        eng = Engine(cfg, params, num_slots=2, num_pages=24, tier=False)
+        r = Request(id="a", prompt=list(prompt), params=sp)
+        slot, first = eng.admit(r)
+        toks = [first]
+        while len(toks) < sp.max_new:
+            out = eng.step()
+            if slot in out.tokens:
+                toks.append(out.tokens[slot])
+        return toks
+
+    eng = Engine(cfg, params, num_slots=2, num_pages=24, tier=True)
+    r = Request(id="a", prompt=list(prompt), params=sp)
+    slot, first = eng.admit(r)
+    r.tokens.append(first)
+    for _ in range(3):
+        out = eng.step()
+        if slot in out.tokens:
+            r.tokens.append(out.tokens[slot])
+    out = eng.flush()
+    if slot in out.tokens:
+        r.tokens.append(out.tokens[slot])
+    eng.spill_stream(slot)
+    eng.release(slot)
+    slot2, first2 = eng.admit(r)
+    toks = list(r.tokens) + [first2]
+    while len(toks) < sp.max_new:
+        out = eng.step()
+        if slot2 in out.tokens:
+            toks.append(out.tokens[slot2])
+    swap_identical = toks == free_run()
+
+    ratio = blind["prefill_tokens"] / max(assisted["prefill_tokens"], 1)
+    att_blind = blind["slo_attainment"]
+    att_assisted = assisted["slo_attainment"]
+    slo_held = (
+        att_blind is None
+        or att_assisted is None
+        or att_assisted >= att_blind - 0.05
+    )
+    return {
+        "rounds": rounds,
+        "blind": blind,
+        "assisted": assisted,
+        "prefill_compute_ratio": round(ratio, 3),
+        "swap_identical": bool(swap_identical),
+        "within_budget": bool(ratio >= 2.0 and slo_held and swap_identical),
+    }
+
+
 def bench_autotune(quick: bool = False):
     """Autotune provenance (maggy_tpu/tune): run the static AOT stage over a
     small mesh/batch grid for the tiny decoder and record what the tuner
@@ -1443,6 +1612,7 @@ def write_run_summary(out) -> str:
         ("paging", "within_budget"),
         ("overlap", "within_budget"),
         ("qos", "no_cliff"),
+        ("fleetkv", "within_budget"),
     ):
         bit = _get(block, key)
         if bit is not None:
@@ -1487,6 +1657,7 @@ def main():
         serve_drain_stats = None
         fleet_stats = None
         qos_stats = None
+        fleetkv_stats = None
         trace_overhead_stats = None
         autopilot_stats = None
         elastic_stats = None
@@ -1524,6 +1695,10 @@ def main():
             qos_stats = bench_qos(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
             qos_stats = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            fleetkv_stats = bench_fleetkv(quick=args.quick)
+        except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
+            fleetkv_stats = {"error": f"{type(e).__name__}: {e}"}
         try:
             trace_overhead_stats = bench_trace_overhead(quick=args.quick)
         except Exception as e:  # noqa: BLE001 - secondary metric must not sink the bench
@@ -1579,6 +1754,7 @@ def main():
             "serve_drain": serve_drain_stats,
             "fleet": fleet_stats,
             "qos": qos_stats,
+            "fleetkv": fleetkv_stats,
             "trace_overhead": trace_overhead_stats,
             "autopilot": autopilot_stats,
             "elastic": elastic_stats,

@@ -356,6 +356,11 @@ class SchedulerTarget:
             # lever the planner shrinks before touching num_slots
             knobs["serve.page_size"] = engine.page_size
             knobs["serve.max_pages_per_req"] = engine.max_pages_per_req
+        if engine.tier is not None:
+            knobs["serve.tier_host_pages"] = engine.tier.stats()[
+                "host_pages_total"
+            ]
+            knobs["serve.tier_low_water_pct"] = engine.tier_policy.low_water_pct
         return knobs
 
     def pending(self) -> bool:
@@ -382,6 +387,18 @@ class SchedulerTarget:
             if not engine.paged:
                 return False
             engine.set_max_pages_per_req(int(value))
+            return True
+        if knob == "serve.tier_host_pages":
+            engine = self.scheduler.engine
+            if engine.tier is None:
+                return False
+            engine.set_tier_host_pages(int(value))
+            return True
+        if knob == "serve.tier_low_water_pct":
+            engine = self.scheduler.engine
+            if engine.tier is None:
+                return False
+            engine.set_tier_low_water(float(value))
             return True
         return False
 
@@ -414,6 +431,7 @@ class RouterTarget:
         return {
             "fleet.admission": cfg.admission,
             "fleet.slo_ttft_ms": cfg.slo_ttft_ms,
+            "fleet.affinity_weight": cfg.affinity_weight_ms,
         }
 
     def pending(self) -> bool:
@@ -429,5 +447,8 @@ class RouterTarget:
                 return True
             if knob == "fleet.slo_ttft_ms":
                 cfg.slo_ttft_ms = float(value)
+                return True
+            if knob == "fleet.affinity_weight":
+                cfg.affinity_weight_ms = float(value)
                 return True
         return False

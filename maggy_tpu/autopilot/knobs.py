@@ -168,6 +168,18 @@ KNOBS = {
             "memory-bound (before sacrificing num_slots concurrency)",
             lo=1, hi=65536,
         ),
+        Knob(
+            "serve.tier_host_pages", "int", "serve", True,
+            "host-DRAM KV tier capacity in pages; grown when memory-bound "
+            "so spill replaces preemption re-prefill (0 disables spills)",
+            lo=0, hi=1_048_576,
+        ),
+        Knob(
+            "serve.tier_low_water_pct", "float", "serve", True,
+            "HBM headroom fraction below which the scheduler spills the "
+            "coldest stream to the host tier each metrics tick",
+            lo=0.0, hi=0.9,
+        ),
         # ---- fleet router (applied by the Router)
         Knob(
             "fleet.admission", "choice", "fleet", True,
@@ -178,6 +190,13 @@ KNOBS = {
             "fleet.slo_ttft_ms", "float", "fleet", True,
             "TTFT budget driving projected-TTFT admission",
             lo=1.0, hi=600_000.0,
+        ),
+        Knob(
+            "fleet.affinity_weight", "float", "fleet", True,
+            "prefix-affinity bonus in ms subtracted from projected TTFT "
+            "for replicas holding the prompt's prefix resident (0 = "
+            "affinity-blind routing; brownout level >= 2 zeroes it)",
+            lo=0.0, hi=10_000.0,
         ),
     )
 }

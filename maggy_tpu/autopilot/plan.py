@@ -223,6 +223,19 @@ class Planner:
             if current.get("serve.async_decode") is False:
                 moves.append(Move("serve.async_decode", True, diag.reason))
         elif diag.bottleneck == "memory_bound":
+            # spill before preempt: growing the host-DRAM tier turns the
+            # next preemption's re-prefill into a cheap swap-in without
+            # giving up any HBM, so it leads the shrink ladder
+            # (docs/serving.md "Host-DRAM page tier")
+            tier = current.get("serve.tier_host_pages")
+            if tier is not None and _grow(KNOBS["serve.tier_host_pages"], tier) != tier:
+                moves.append(
+                    Move(
+                        "serve.tier_host_pages",
+                        _grow(KNOBS["serve.tier_host_pages"], tier),
+                        diag.reason,
+                    )
+                )
             # paged engines shrink the per-request page cap FIRST: it
             # bounds worst-case footprint without sacrificing concurrency;
             # cutting num_slots is the blunt fallback (docs/serving.md

@@ -334,6 +334,18 @@ def _capacity_parts(sv: dict) -> list:
     if top:
         t = top[0]
         parts.append(f"top {t.get('digest', '?')} x{t.get('hits', 0)}")
+    # host-DRAM KV tier (docs/serving.md "Host-DRAM page tier"): pool
+    # occupancy plus spill/fill traffic; the fleet aggregate sums the
+    # same counters across enabled replicas under capacity.tier
+    tier = sv.get("tier") or cap.get("tier") or {}
+    if tier.get("enabled") or tier.get("replicas"):
+        total = tier.get("host_pages_total", 0)
+        free = tier.get("host_pages_free", 0)
+        parts.append(
+            f"tier {total - free}/{total}pg "
+            f"{tier.get('resident_packs', 0)}pk "
+            f"s{tier.get('spills', 0)}/f{tier.get('fills', 0)}"
+        )
     pc = sv.get("profcap") or {}
     if pc.get("captures"):
         parts.append(f"PROFCAP {pc['captures']}")

@@ -31,6 +31,10 @@ Seams (all zero-cost when no plan is installed):
 * The traffic generator (``serve/loadgen.py``) consults ``tenant_burst``
   while building a schedule — one tenant's offered load is multiplied,
   driving the brownout ladder without a bespoke traffic spec.
+* The host-DRAM KV tier (``serve/tier/host_pool.py``) consults
+  ``host_pool_slow`` per pack fill — swap-in latency lands in admission
+  TTFT, exercising the tier's degraded-but-correct path (docs/serving.md
+  "Host-DRAM page tier").
 * ``Trainer.fit`` consults ``slice_drop`` / ``slice_rejoin`` each step when
   running under an elastic membership monitor — a matching ``slice_drop``
   raises :class:`~maggy_tpu.resilience.membership.SliceLost` (the slice's
@@ -78,6 +82,7 @@ KINDS = frozenset(
         "slice_rejoin",  # a dropped slice comes back at step K
         "replica_slow",  # gray failure: delay replica N's admissions by ms=K
         "tenant_burst",  # multiply tenant T's offered load by mult=M (loadgen)
+        "host_pool_slow",  # delay host-DRAM KV tier swap-ins by ms=K
     }
 )
 
@@ -193,6 +198,15 @@ class Chaos:
         (docs/resilience.md "Gray failure"). Spell sustained slowness with
         ``times=N``: ``replica_slow:replica=1,ms=300,times=50``."""
         fault = self.fire("replica_slow", replica=replica)
+        return fault.arg if fault is not None else 0.0
+
+    def host_pool_slow(self) -> float:
+        """Seconds of swap-in latency to inject into the next host-DRAM KV
+        tier fill (0.0 = none). ``HostPagePool.get`` consults it per pack
+        fill — outside its lock — so a slow host-memory path surfaces as
+        admission TTFT, the same signal a genuinely DMA-bound swap-in
+        would produce: ``host_pool_slow:ms=50,times=10``."""
+        fault = self.fire("host_pool_slow")
         return fault.arg if fault is not None else 0.0
 
     def tenant_burst(self, tenant: Any) -> float:

@@ -88,6 +88,7 @@ from maggy_tpu.serve.paging import BlockAllocator, OutOfPagesError, PageTable
 from maggy_tpu.serve.prefix import PrefixIndex
 from maggy_tpu.serve.request import Request
 from maggy_tpu.serve.slots import SlotManager, SlotOccupiedError
+from maggy_tpu.serve.tier import HostPagePool, TieringPolicy
 
 # fixed-size top-k filter: per-request top_k rides in as an array, the kth
 # threshold is read from a static top-TOPK_CAP sort, keeping the decode step
@@ -151,6 +152,9 @@ class Engine:
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         max_pages_per_req: Optional[int] = None,
+        tier: Optional[bool] = None,
+        tier_host_pages: Optional[int] = None,
+        tier_low_water_pct: Optional[float] = None,
     ):
         from maggy_tpu.models import Decoder
 
@@ -188,6 +192,10 @@ class Engine:
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
         self.prefill_calls = 0  # full (from-scratch) prefills
+        # prompt tokens ACTUALLY computed by prefill (suffix-only on any
+        # reuse path) — the figure the fleet-KV bench compares across
+        # affinity settings (bench.py extra.fleetkv)
+        self.prefill_tokens = 0
 
         # ---- paged KV cache (docs/serving.md "Paged KV cache")
         if paged is None:
@@ -244,6 +252,33 @@ class Engine:
         # the model behind the batch decode step (prefill always runs the
         # dense single-row variant; paged admission re-pages its output)
         self._batch_model = self.paged_model or self.decode_model
+
+        # ---- host-DRAM KV tier (docs/serving.md "Host-DRAM page tier")
+        if tier is None:
+            tier = os.environ.get(
+                "MAGGY_TPU_SERVE_TIER", "1"
+            ).lower() not in ("0", "false", "off")
+        self._tier_pages_explicit = tier_host_pages is not None
+        if self.paged and tier:
+            if tier_host_pages is None:
+                tier_host_pages = int(
+                    os.environ.get(
+                        "MAGGY_TPU_SERVE_TIER_PAGES", 2 * self.num_pages
+                    )
+                )
+            self.tier = HostPagePool(
+                int(tier_host_pages), telemetry_recorder=self.telemetry
+            )
+            self.tier_policy = (
+                TieringPolicy(low_water_pct=float(tier_low_water_pct))
+                if tier_low_water_pct is not None
+                else TieringPolicy()
+            )
+        else:
+            # dense mode has no page-granular KV to spill; the tier is a
+            # paged-cache feature, quietly off otherwise
+            self.tier = None
+            self.tier_policy = None
 
         B = num_slots
         dummy = jnp.zeros((B, 1), jnp.int32)
@@ -643,10 +678,16 @@ class Engine:
         key_pair = jnp.asarray(_base_key_data(p.seed))
         slot = self.slots.free_slots()[0]
         reuse = self._match_prefix(prompt)
-        if self.paged:
-            tok = self._admit_paged(prompt, p, slot, gen0, reuse, key_pair)
-        else:
-            tok = self._admit_dense(prompt, p, slot, gen0, reuse, key_pair)
+        tok = None
+        if self.tier is not None:
+            tok = self._try_tier_admit(
+                prompt, p, slot, gen0, reuse, key_pair, request
+            )
+        if tok is None:
+            if self.paged:
+                tok = self._admit_paged(prompt, p, slot, gen0, reuse, key_pair)
+            else:
+                tok = self._admit_dense(prompt, p, slot, gen0, reuse, key_pair)
         # claim the slot only after every device op succeeded — a throwing
         # prefill/admit must not leak an occupied slot bound to a dead request
         first = int(tok)
@@ -687,6 +728,7 @@ class Engine:
                     key_pair,
                 )
             self._note_prefix_hit(shared, 0)
+            self.prefill_tokens += plen - shared
         else:
             bucket = self._bucket(plen)
             padded = np.zeros((1, bucket), np.int32)
@@ -710,6 +752,7 @@ class Engine:
                     key_pair,
                 )
             self.prefill_calls += 1
+            self.prefill_tokens += plen
         return tok
 
     def _admit_paged(self, prompt, p, slot, gen0, reuse, key_pair):
@@ -764,6 +807,7 @@ class Engine:
                 self.allocator.release(page_list)
                 raise
             self._note_prefix_hit(shared, shared_full)
+            self.prefill_tokens += plen - shared
         else:
             fresh = self.allocator.alloc(n_prompt_pages)
             page_list = fresh
@@ -797,6 +841,7 @@ class Engine:
                 self.allocator.release(fresh)
                 raise
             self.prefill_calls += 1
+            self.prefill_tokens += plen
         self.page_table.assign(slot, page_list)
         self.allocator.touch(page_list, self.steps)
         self._peak_pages[slot] = len(page_list)
@@ -826,6 +871,247 @@ class Engine:
         if shared < self.prefix_min:
             return None
         return src, shared
+
+    # ------------------------------------------------- host-DRAM KV tier
+
+    def _tier_capture_pages(self, page_ids) -> Dict[str, np.ndarray]:
+        """Device→host copy of the pool pages ``page_ids``, one
+        ``[n, P, Kh, Dh]`` block stack per cache leaf (scanned leaves
+        carry the layer axis in front: ``[n, L, P, Kh, Dh]``). Same
+        ``jax.device_get`` serialization seam as the disaggregated
+        prefill pack, so bytes survive the round trip."""
+        ids = [int(p) for p in page_ids]
+        blocks: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self.cache)[0]:
+            ks = jax.tree_util.keystr(path)
+            if "pages" in ks or "index" in ks:
+                continue  # host-owned table / per-row write index
+            if leaf.ndim == 5:  # scanned pool [L, N, P, Kh, Dh]
+                blocks[ks] = np.moveaxis(jax.device_get(leaf[:, ids]), 1, 0)
+            else:  # [N, P, Kh, Dh]
+                blocks[ks] = jax.device_get(leaf[ids])
+        return blocks
+
+    def _tier_write_pages(self, page_ids, blocks) -> None:
+        """Scatter host page blocks back into the device pool at
+        ``page_ids`` — the eager inverse of :meth:`_tier_capture_pages`,
+        run before the compiled suffix-admit gathers through them."""
+        ids = jnp.asarray([int(p) for p in page_ids], jnp.int32)
+
+        def write(path, leaf):
+            ks = jax.tree_util.keystr(path)
+            if ks not in blocks:
+                return leaf
+            b = blocks[ks]
+            if leaf.ndim == 5:
+                return leaf.at[:, ids].set(
+                    jnp.asarray(np.moveaxis(b, 0, 1), leaf.dtype)
+                )
+            return leaf.at[ids].set(jnp.asarray(b, leaf.dtype))
+
+        self.cache = jax.tree_util.tree_map_with_path(write, self.cache)
+
+    def spill_stream(self, slot: int, pressure: bool = False) -> bool:
+        """Capture a resident stream's valid KV pages into the host tier
+        as a resume pack (``rid:<id>``) — the scheduler calls this
+        immediately BEFORE preempt-releasing the slot, so re-admission
+        becomes a swap-in instead of a full re-prefill. Valid rows are
+        ``[0, len(prompt+tokens) - 1)``: prefill wrote the prompt's rows,
+        each drained decode step wrote one more, and the newest sampled
+        token was never fed back — exactly the rows re-prefill would
+        recompute, so the swapped-in stream is byte-identical. False (no
+        side effects) when the tier is off, the slot is empty, or the
+        pack does not fit the host budget."""
+        if self.tier is None:
+            return False
+        st = self.slots.get(slot)
+        if st is None:
+            return False
+        tokens = [int(t) for t in st.request.prompt] + [
+            int(t) for t in st.request.tokens
+        ]
+        valid = len(tokens) - 1
+        if valid < 1:
+            return False
+        pages = self.page_table.pages(slot)
+        need = (valid - 1) // self.page_size + 1
+        if len(pages) < need:
+            return False
+        t0 = time.perf_counter()
+        blocks = self._tier_capture_pages(pages[:need])
+        ok = self.tier.put(
+            f"rid:{st.request.id}",
+            blocks,
+            {"tokens": tuple(tokens), "valid": valid, "kind": "resume"},
+        )
+        if ok:
+            self.tier_policy.note_spill(need, pressure=pressure)
+            self.telemetry.count("tier.spills")
+            self.telemetry.count("tier.spilled_pages", need)
+            if pressure:
+                self.telemetry.count("tier.pressure_spills")
+            self.telemetry.histogram(
+                "tier.spill_ms", (time.perf_counter() - t0) * 1e3
+            )
+        return ok
+
+    def _spill_prefix(self, slot: int) -> None:
+        """On release, park the departing prompt's full KV pages in the
+        host tier as a prefix pack (``px:<digest>``) so a later request
+        sharing the prefix swaps it in instead of re-prefilling — prefix
+        reuse that survives eviction (docs/fleet.md "Fleet-global KV").
+        Gated to prompts of at least one full page; best-effort."""
+        if self.tier is None:
+            return
+        prompt = self.prefix_index.resident().get(slot)
+        if not prompt:
+            return
+        prompt = tuple(int(t) for t in prompt)
+        plen0 = len(prompt)
+        if plen0 < self.page_size:
+            return  # under one page: re-prefill beats a pack round-trip
+        pages = self.page_table.pages(slot)
+        valid = min(plen0, len(pages) * self.page_size)
+        if valid < self.page_size:
+            return
+        need = (valid - 1) // self.page_size + 1
+        t0 = time.perf_counter()
+        blocks = self._tier_capture_pages(pages[:need])
+        if self.tier.put(
+            f"px:{PrefixIndex.digest(prompt)}",
+            blocks,
+            {"tokens": prompt, "valid": valid, "kind": "prefix"},
+        ):
+            self.tier_policy.note_spill(need, prefix=True)
+            self.telemetry.count("tier.spills")
+            self.telemetry.count("tier.prefix_spills")
+            self.telemetry.count("tier.spilled_pages", need)
+            self.telemetry.histogram(
+                "tier.spill_ms", (time.perf_counter() - t0) * 1e3
+            )
+
+    def _try_tier_admit(self, prompt, p, slot, gen0, reuse, key_pair, request):
+        """Tier-first admission: a resume pack (exact token match on this
+        request's id) wins outright; otherwise a prefix pack is used only
+        when it covers MORE shared tokens than the device-resident prefix
+        index would. Returns the first sampled token, or None to fall
+        through to the normal admit paths."""
+        plen = len(prompt)
+        if gen0 > 0:
+            key = f"rid:{request.id}"
+            got = self.tier.get(key) if self.tier.has(key) else None
+            if got is not None:
+                blocks, meta = got
+                start = int(meta.get("valid", 0))
+                if (
+                    meta.get("kind") == "resume"
+                    and tuple(meta.get("tokens", ())) == tuple(prompt)
+                    and 1 <= start <= plen - 1
+                ):
+                    t0 = time.perf_counter()
+                    tok = self._tier_admit(
+                        prompt, p, slot, gen0, key_pair, blocks, start
+                    )
+                    self.tier.drop(key)  # one resume per preemption
+                    n = next(iter(blocks.values())).shape[0]
+                    self.tier_policy.note_fill(n)
+                    self.telemetry.count("tier.fills")
+                    self.telemetry.count("tier.filled_pages", n)
+                    self.telemetry.histogram(
+                        "tier.swap_in_ms", (time.perf_counter() - t0) * 1e3
+                    )
+                    self.prefill_tokens += plen - start
+                    return tok
+                self.tier.drop(key)  # stale pack: request state moved on
+        if not self.prefix_reuse or plen - 1 < self.prefix_min:
+            return None
+        key = f"px:{PrefixIndex.digest(prompt)}"
+        got = self.tier.get(key) if self.tier.has(key) else None
+        if got is None:
+            return None
+        blocks, meta = got
+        mtok = tuple(meta.get("tokens", ()))
+        shared = 0
+        for a, b in zip(mtok, prompt):
+            if a != b:
+                break
+            shared += 1
+        shared = min(shared, int(meta.get("valid", 0)), plen - 1)
+        dev_shared = reuse[1] if reuse is not None else 0
+        if shared < self.prefix_min or shared <= dev_shared:
+            return None  # digest collision, or HBM-resident reuse is better
+        t0 = time.perf_counter()
+        cover = (shared - 1) // self.page_size + 1
+        tok = self._tier_admit(
+            prompt, p, slot, gen0, key_pair,
+            {ks: arr[:cover] for ks, arr in blocks.items()}, shared,
+        )
+        self.tier_policy.note_fill(cover, prefix=True)
+        self.telemetry.count("tier.fills")
+        self.telemetry.count("tier.prefix_fills")
+        self.telemetry.count("tier.filled_pages", cover)
+        self.telemetry.histogram(
+            "tier.swap_in_ms", (time.perf_counter() - t0) * 1e3
+        )
+        self.prefill_tokens += plen - shared
+        self._note_prefix_hit(shared, 0)
+        return tok
+
+    def _tier_admit(self, prompt, p, slot, gen0, key_pair, blocks, start):
+        """Shared restore path for both pack kinds: materialize the
+        pack's pages into freshly allocated pool pages, then run ONLY the
+        suffix (positions ``start..plen``) through the existing compiled
+        prefix-admit program — same bucket ladder, no new jit body, and
+        byte-identical to a full prefill because the restored rows are
+        the full prefill's own bytes."""
+        plen = len(prompt)
+        P = self.page_size
+        n_prompt_pages = -(-plen // P)
+        # pages carrying restored rows [0, start); the suffix writes from
+        # the page containing row ``start`` upward (the boundary page is
+        # re-written WHOLE from the workspace row, whose low rows are the
+        # restored bytes — idempotent, like every paged admit)
+        cover = (start - 1) // P + 1
+        fresh = self.allocator.alloc(n_prompt_pages)
+        try:
+            self._tier_write_pages(
+                fresh[:cover], {ks: arr[:cover] for ks, arr in blocks.items()}
+            )
+            src_row_ids = np.zeros((self.pages_per_row,), np.int32)
+            src_row_ids[:cover] = fresh[:cover]
+            write_ids = np.zeros((self.pages_per_row,), np.int32)
+            boundary = start // P
+            write_ids[boundary:n_prompt_pages] = fresh[boundary:n_prompt_pages]
+            bucket = min(self._bucket(plen - start), self.max_seq_len - start)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : plen - start] = prompt[start:]
+            with self.telemetry.span(
+                "serve.prefix_admit", bucket=bucket, shared=start
+            ), self._ctx():
+                self.cache, self.key_data, tok = self._paged_prefix_admit_jit(
+                    self.params,
+                    self.cache,
+                    self.key_data,
+                    jnp.asarray(src_row_ids),
+                    jnp.asarray(write_ids),
+                    jnp.int32(slot),
+                    jnp.asarray(padded),
+                    jnp.int32(start),
+                    jnp.int32(plen),
+                    jnp.int32(gen0),
+                    jnp.float32(p.temperature),
+                    jnp.int32(p.top_k),
+                    key_pair,
+                )
+        except Exception:
+            self.allocator.release(fresh)
+            raise
+        self.page_table.assign(slot, fresh)
+        self.allocator.touch(fresh, self.steps)
+        self._peak_pages[slot] = len(fresh)
+        self._push_page_table()
+        self._pages_gauges()
+        return tok
 
     def reconfigure(self, num_slots: int) -> None:
         """Drain-and-reconfigure seam: rebuild the slot geometry with
@@ -873,6 +1159,11 @@ class Engine:
             self.allocator = BlockAllocator(self.num_pages, self.page_size)
             self.page_table = PageTable(B, self.pages_per_row)
             self._last_page_gauges = None
+            # the host tier survives reconfigure — block shapes depend
+            # only on page_size, and prefix packs are content-addressed —
+            # but an un-pinned budget tracks the new pool size
+            if self.tier is not None and not self._tier_pages_explicit:
+                self.tier.set_capacity(2 * self.num_pages)
         self._peak_pages = {}
         self.cache = init_cache(
             self._batch_model, jnp.zeros((B, 1), jnp.int32), mesh=self.mesh
@@ -904,6 +1195,11 @@ class Engine:
         are routed to the scratch page, and admission overwrites whole
         pages/rows."""
         if self.paged:
+            if self.tier is not None:
+                try:
+                    self._spill_prefix(slot)
+                except Exception:
+                    pass  # best-effort: a failed spill never blocks release
             pages = self.page_table.clear(slot)
             if pages:
                 self.allocator.release(pages)
@@ -1160,6 +1456,7 @@ class Engine:
                 jnp.int32(gen0),
             )
         self.prefill_calls += 1
+        self.prefill_tokens += plen
         self._record_compile_gauges()
         return {
             "row": jax.device_get(row_cache),
@@ -1268,9 +1565,28 @@ class Engine:
             "prefix_hits": self.prefix_hits,
             "prefix_tokens_saved": self.prefix_tokens_saved,
             "prefill_calls": self.prefill_calls,
+            "prefill_tokens": self.prefill_tokens,
             "prefix_residency": self.prefix_index.residency_stats(
                 gen=self.steps
             ),
+        }
+
+    @property
+    def tier_stats(self) -> Dict[str, Any]:
+        """Host-DRAM tier accounting for SSTATS/monitor/bench: pool
+        occupancy plus the policy's spill/fill ledger. ``{"enabled":
+        False}`` when the tier is off so panels can branch safely."""
+        if self.tier is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            **self.tier.stats(),
+            **self.tier_policy.stats(),
+            # host-resident prefix digests, so the fleet prefix map counts
+            # a spilled-but-swappable prefix as held by this replica
+            "prefix_digests": [
+                k[3:] for k in self.tier.keys() if k.startswith("px:")
+            ],
         }
 
     @property
@@ -1294,3 +1610,19 @@ class Engine:
         many pages ONE request may hold. Applies to future admissions and
         growth denials only — resident requests keep what they own."""
         self.max_pages_per_req = max(1, min(self.pages_per_row, int(value)))
+
+    def set_tier_host_pages(self, value: int) -> None:
+        """Autopilot seam (``serve.tier_host_pages``, safe-live): resize
+        the host tier's page budget. Shrink evicts LRU packs immediately;
+        an explicit value pins the budget across reconfigures."""
+        if self.tier is None:
+            return
+        self._tier_pages_explicit = True
+        self.tier.set_capacity(int(value))
+
+    def set_tier_low_water(self, value: float) -> None:
+        """Autopilot seam (``serve.tier_low_water_pct``, safe-live): move
+        the pressure-spill trigger's headroom threshold."""
+        if self.tier_policy is None:
+            return
+        self.tier_policy.low_water_pct = float(value)

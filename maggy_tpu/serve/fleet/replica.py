@@ -233,6 +233,10 @@ class ReplicaSpec:
     paged: Optional[bool] = None
     page_size: Optional[int] = None
     num_pages: Optional[int] = None
+    # host-DRAM KV tier (docs/serving.md "Host-DRAM page tier"); None:
+    # engine default (on for paged engines, MAGGY_TPU_SERVE_TIER gated)
+    tier: Optional[bool] = None
+    tier_host_pages: Optional[int] = None
     # TTFT budget handed to each replica's scheduler so per-replica SSTATS
     # carry exact slo_ok/slo_miss counters (launch_fleet seeds it from
     # RouterConfig.slo_ttft_ms)
@@ -290,6 +294,8 @@ class Replica:
             paged=spec.paged,
             page_size=spec.page_size,
             num_pages=spec.num_pages,
+            tier=spec.tier,
+            tier_host_pages=spec.tier_host_pages,
         )
         scheduler = Scheduler(engine, slo_ttft_ms=spec.slo_ttft_ms)
         # the replica_slow chaos seam keys on this index so one replica can

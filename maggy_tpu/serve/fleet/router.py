@@ -73,8 +73,10 @@ from maggy_tpu.serve.fleet.replica import (
     Replica,
     RetryBudget,
 )
+from maggy_tpu.serve.prefix import PrefixIndex
 from maggy_tpu.serve.qos import BEST_EFFORT, QOS_CLASSES, validate_qos
 from maggy_tpu.serve.scheduler import LATENCY_SIGNALS
+from maggy_tpu.serve.tier import FleetPrefixMap
 from maggy_tpu.telemetry import timeseries, tracing
 from maggy_tpu.telemetry.alerts import AlertEvaluator
 from maggy_tpu.telemetry.histogram import merge_dicts
@@ -130,6 +132,13 @@ class RouterConfig:
     # requeues are deferred (never dropped) so storms can't amplify load
     retry_budget: int = 8
     retry_budget_window_s: float = 10.0
+    # prefix-affinity routing (docs/fleet.md "Fleet-global KV"): a replica
+    # the fleet prefix map reports holding this prompt's prefix resident
+    # gets this many ms subtracted from its projected TTFT — roughly the
+    # prefill time the resident prefix saves. 0 disables; the autopilot
+    # tunes it (``fleet.affinity_weight``) and brownout level >= 2 zeroes
+    # it so affinity never fights load-shedding under overload
+    affinity_weight_ms: float = 25.0
 
     def validate(self) -> None:
         if self.admission not in ("queue", "shed"):
@@ -370,6 +379,11 @@ class Router:
             # best-effort dispatches clamped by the brownout ladder
             "retry_deferred": 0,
             "brownout_clamped": 0,
+            # prefix-affinity routing: picks that landed on a replica the
+            # fleet prefix map reported resident vs. picks where holders
+            # existed but load won (docs/fleet.md "Fleet-global KV")
+            "affinity_hits": 0,
+            "affinity_misses": 0,
         }
         # exact SLO attainment at the fleet edge: counted per completed
         # request against the configured TTFT budget (histogram-derived
@@ -397,6 +411,11 @@ class Router:
             r.index: RetryBudget(cfg.retry_budget, cfg.retry_budget_window_s)
             for r in self.replicas
         }
+        # fleet prefix map (docs/fleet.md "Fleet-global KV"): digest ->
+        # replicas holding it resident, fed from the SSTATS residency
+        # snapshots the pump already polls; read at dispatch for the
+        # affinity bonus
+        self.prefix_map = FleetPrefixMap()
         # brownout ladder: stepped by the pump tick off the SLO burn alert
         self.brownout = BrownoutLadder(
             escalate_s=cfg.brownout_escalate_s,
@@ -502,18 +521,42 @@ class Router:
         ]
 
     def _pick_replica(  # guarded-by: _lock
-        self, healthy: List[Replica]
+        self,
+        healthy: List[Replica],
+        digest: Optional[str] = None,
+        affinity_ms: float = 0.0,
     ) -> Tuple[Replica, float]:
         """Least projected TTFT; round-robin cursor breaks ties so equal
-        replicas share load instead of all traffic piling on index 0."""
+        replicas share load instead of all traffic piling on index 0.
+
+        With a prompt ``digest``, replicas the fleet prefix map reports
+        holding that prefix resident get ``affinity_ms`` subtracted from
+        their projection (docs/fleet.md "Fleet-global KV") — a bounded
+        nudge, so a genuinely overloaded holder still loses the pick; the
+        caller zeroes the bonus at brownout level >= 2."""
         cfg = self.config
+        holders = (
+            self.prefix_map.replicas_for(digest)
+            if digest is not None and affinity_ms > 0
+            else frozenset()
+        )
         scored = []
         for offset in range(len(healthy)):
             r = healthy[(self._rr + offset) % len(healthy)]
             stats = self._stats_cache.get(r.index, {})
-            scored.append((projected_ttft_ms(stats, cfg.default_service_ms), r))
+            proj = projected_ttft_ms(stats, cfg.default_service_ms)
+            if r.index in holders:
+                proj -= affinity_ms
+            scored.append((proj, r))
         proj, best = min(scored, key=lambda pr: pr[0])
         self._rr += 1
+        if holders:
+            if best.index in holders:
+                self.counters["affinity_hits"] += 1
+                self.telemetry.count("tier.affinity_hits")
+            else:
+                self.counters["affinity_misses"] += 1
+                self.telemetry.count("tier.affinity_misses")
         return best, proj
 
     # ----------------------------------------------------------------- verbs
@@ -785,6 +828,28 @@ class Router:
             )
             for t in resid.get("top") or []:
                 capacity["top_prefixes"].append(dict(t, replica=r.index))
+            tier = stats.get("tier") or {}
+            if tier.get("enabled"):
+                agg_tier = capacity.setdefault(
+                    "tier",
+                    {
+                        "replicas": 0,
+                        "host_pages_total": 0,
+                        "host_pages_free": 0,
+                        "resident_packs": 0,
+                        "spills": 0,
+                        "fills": 0,
+                    },
+                )
+                agg_tier["replicas"] += 1
+                for k in (
+                    "host_pages_total",
+                    "host_pages_free",
+                    "resident_packs",
+                    "spills",
+                    "fills",
+                ):
+                    agg_tier[k] += int(tier.get(k) or 0)
             for name, d in (stats.get("latency") or {}).items():
                 latency_dicts.setdefault(name, []).append(d)
         merged = {
@@ -848,6 +913,7 @@ class Router:
             by_digest.values(),
             key=lambda d: (-d["hits"], -d["bytes"], str(d["digest"])),
         )[:4]
+        capacity["prefix_map"] = self.prefix_map.snapshot()
         agg["capacity"] = capacity
         # ALERTS surface: fleet-scope rules plus whatever each replica's
         # worker-scope evaluator reports in its SSTATS
@@ -945,6 +1011,24 @@ class Router:
             frag = paging.get("fragmentation") or {}
             resid = stats.get("prefix_residency") or {}
             memory = stats.get("memory") or {}
+            # feed the fleet prefix map from this replica's residency
+            # sample — device-resident anchors plus host-tier prefix packs
+            # (a spilled prefix is still one cheap swap-in away); called
+            # outside _lock (prefix_map has its own leaf lock) so a slow
+            # snapshot never stalls dispatch
+            self.prefix_map.update(
+                idx,
+                [
+                    str(t.get("digest"))
+                    for t in (resid.get("top") or [])
+                    if t.get("digest")
+                ]
+                + [
+                    str(d)
+                    for d in (stats.get("tier") or {}).get("prefix_digests")
+                    or []
+                ],
+            )
             store.ingest(
                 now,
                 gauges={
@@ -1192,6 +1276,9 @@ class Router:
         breaker = self.breakers.get(replica.index)
         if breaker is not None:
             breaker.probe_lost()
+        # a dead replica's resident prefixes are unreachable — drop its
+        # contribution so affinity never routes toward a corpse
+        self.prefix_map.forget_replica(replica.index)
         with self._lock:
             if replica.index in self._down_handled:
                 return
@@ -1361,7 +1448,22 @@ class Router:
                         entry, "expired", "deadline exceeded in router queue"
                     )
                     continue
-                best, proj = self._pick_replica(candidates)
+                # prefix-affinity term (docs/fleet.md "Fleet-global KV"):
+                # brownout level >= 2 zeroes the bonus — under overload,
+                # raw load beats locality (level was read outside _lock,
+                # keeping the brownout lock out of this critical section)
+                digest = None
+                affinity_ms = 0.0
+                if cfg.affinity_weight_ms > 0 and level < 2:
+                    prompt = entry.payload.get("prompt") or ()
+                    if prompt:
+                        digest = PrefixIndex.digest(
+                            tuple(int(t) for t in prompt)
+                        )
+                        affinity_ms = cfg.affinity_weight_ms
+                best, proj = self._pick_replica(
+                    candidates, digest=digest, affinity_ms=affinity_ms
+                )
                 if breaker_gated:
                     # probation first: a half-open replica can never win the
                     # latency pick (its cached stats are the slow ones that
@@ -1382,7 +1484,10 @@ class Router:
                             ]
                             if not remaining:
                                 return
-                            best, proj = self._pick_replica(remaining)
+                            best, proj = self._pick_replica(
+                                remaining, digest=digest,
+                                affinity_ms=affinity_ms,
+                            )
                             if not self.breakers[best.index].take_probe(rid):
                                 return
                 entry.not_before_ts = None

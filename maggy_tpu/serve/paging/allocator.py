@@ -169,13 +169,28 @@ class BlockAllocator:
                 cold += 1
         return {"hot": hot, "warm": warm, "cold": cold}
 
-    def coldest(self, n: Optional[int] = None) -> List[int]:
+    def coldest(
+        self, n: Optional[int] = None, include_shared: bool = False
+    ) -> List[int]:
         """Referenced pages ranked coldest-first (oldest last-access
         generation; never-touched pages first of all) — the eviction-candidate
-        ordering the KV-tiering PR consumes as-is. Ties break on page id for
-        determinism."""
+        ordering the host-DRAM tiering consumes. Ties break on page id for
+        determinism.
+
+        Shared pages (refcount >= 2) are EXCLUDED by default: a
+        prefix-aliased page is live working set for every request holding
+        it, however stale its heat stamp looks — spilling one out from
+        under an active sharer would corrupt a stream that never chose to
+        be evicted. ``include_shared=True`` restores the raw ranking for
+        observability callers that want the full heat picture."""
         la = self._last_access
-        ranked = sorted(self._refs, key=lambda p: (la.get(p, -1), p))
+        refs = self._refs
+        pages = (
+            refs
+            if include_shared
+            else (p for p, c in refs.items() if c < 2)
+        )
+        ranked = sorted(pages, key=lambda p: (la.get(p, -1), p))
         return ranked if n is None else ranked[: int(n)]
 
     # ---------------------------------------------------------- fragmentation
@@ -184,10 +199,21 @@ class BlockAllocator:
         """Free-run-length distribution: how contiguous the free pool is.
         ``frag_ratio`` is 0.0 when all free pages form one run (or none are
         free) and approaches 1.0 as the free space shatters into single-page
-        runs — a threshold alert rule watches this via ``serve.fragmentation``."""
+        runs — a threshold alert rule watches this via ``serve.fragmentation``.
+
+        The alias-aware pair sizes what tiering could actually reclaim:
+        ``pages_pinned_shared`` (refcount >= 2 — never spill-eligible while
+        any sharer is active) and ``pages_reclaimable`` (refcount 1 — one
+        release or spill away from free). They always sum with the free
+        count to the whole pool."""
         free = sorted(self._free)
+        shared = sum(1 for c in self._refs.values() if c >= 2)
+        extra = {
+            "pages_pinned_shared": shared,
+            "pages_reclaimable": len(self._refs) - shared,
+        }
         if not free:
-            return {"free_runs": 0, "largest_run": 0, "frag_ratio": 0.0}
+            return {"free_runs": 0, "largest_run": 0, "frag_ratio": 0.0, **extra}
         runs = 1
         largest = cur = 1
         for prev, nxt in zip(free, free[1:]):
@@ -202,6 +228,7 @@ class BlockAllocator:
             "free_runs": runs,
             "largest_run": largest,
             "frag_ratio": round(1.0 - largest / len(free), 4),
+            **extra,
         }
 
     def check_invariants(self) -> None:
@@ -218,6 +245,16 @@ class BlockAllocator:
         assert (frag["largest_run"] == 0) == (not self._free)
         assert frag["largest_run"] <= len(self._free)
         assert 0.0 <= frag["frag_ratio"] <= 1.0
+        # alias consistency: spill candidates never include a shared page,
+        # and the reclaimable/pinned split tiles the referenced set
+        shared = {p for p, c in self._refs.items() if c >= 2}
+        assert not (set(self.coldest()) & shared), (
+            "shared page ranked spill-eligible"
+        )
+        assert (
+            frag["pages_pinned_shared"] + frag["pages_reclaimable"]
+            == len(self._refs)
+        )
 
     def stats(self) -> Dict[str, int]:
         return {

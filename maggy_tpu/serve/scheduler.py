@@ -343,6 +343,30 @@ class Scheduler:
         res = eng.prefix_index.residency_stats(gen=eng.steps)
         tel.gauge("serve.prefix_resident_bytes", res["resident_bytes"])
         tel.gauge("serve.prefix_resident_count", res["resident_prefixes"])
+        if eng.tier is not None:
+            # pressure spill (docs/serving.md "Host-DRAM page tier"): when
+            # reconciled HBM headroom sits under the tier's low-water mark,
+            # preempt-with-spill the coldest low-class stream — at most one
+            # per tick — freeing pool pages BEFORE an admission runs the
+            # allocator dry and has to preempt under the gun
+            if eng.tier_policy.should_spill(self._last_headroom_pct):
+                self._drain_inflight()
+                actives = eng.slots.active_slots()
+                if actives:
+
+                    def _rank(slot: int):
+                        r = eng.slots.get(slot).request
+                        return (QOS_PRIORITY.get(r.qos, len(QOS_CLASSES)),
+                                r.admitted_ts or 0.0, slot)
+
+                    self._preempt_victim(
+                        max(actives, key=_rank), False, pressure=True
+                    )
+            ts = eng.tier.stats()
+            tel.gauge("tier.host_pages_free", ts["host_pages_free"])
+            tel.gauge("tier.host_pages_total", ts["host_pages_total"])
+            tel.gauge("tier.host_bytes", ts["host_bytes"])
+            tel.gauge("tier.resident_packs", ts["resident_packs"])
         self.metrics.sample(self.telemetry, now)
         if self.slo_ttft_ms is not None:
             with self._lock:
@@ -399,6 +423,9 @@ class Scheduler:
                 # controller state (docs/observability.md "Capacity")
                 "memory": self.memory.snapshot(),
                 "profcap": self.profcap.snapshot(),
+                # host-DRAM KV tier view (docs/serving.md "Host-DRAM page
+                # tier"): pool occupancy + the spill/fill ledger
+                "tier": engine.tier_stats,
                 # per-class QoS view (docs/fleet.md "QoS classes"): queue
                 # depths, lifetime admission/preempt/defer counts, and the
                 # quota ledger's windowed token shares
@@ -718,13 +745,22 @@ class Scheduler:
             )
             self._preempt_victim(victim, for_priority)
 
-    def _preempt_victim(self, victim: int, for_priority: bool) -> None:
-        """THE victim seam shared by decode-growth and admission preemption:
-        release the slot (pages, anchor, row) through ``_release_slot``,
-        requeue the request at the front of its class with prompt AND
-        generated tokens retained, and account it — the byte-identical
-        resume guarantee lives entirely in this one path."""
+    def _preempt_victim(
+        self, victim: int, for_priority: bool, pressure: bool = False
+    ) -> None:
+        """THE victim seam shared by decode-growth, admission, and
+        tier-pressure preemption: spill the victim's KV to the host tier
+        (when one is attached — re-admission then swaps in instead of
+        re-prefilling), release the slot (pages, anchor, row) through
+        ``_release_slot``, requeue the request at the front of its class
+        with prompt AND generated tokens retained, and account it — the
+        byte-identical resume guarantee lives entirely in this one path."""
         req = self.engine.slots.get(victim).request
+        if self.engine.tier is not None:
+            try:
+                self.engine.spill_stream(victim, pressure=pressure)
+            except Exception:  # noqa: BLE001 - spill is best-effort; preempt must proceed
+                pass
         self._release_slot(victim)
         with self._wake:
             req.state = rq.QUEUED

@@ -1,0 +1,184 @@
+"""Host-DRAM KV page pool — the second tier under the HBM page pool.
+
+The engine's :class:`~maggy_tpu.serve.paging.BlockAllocator` owns the HBM
+pages; this pool owns their host-side shadow. KV pages cross the boundary
+as plain numpy blocks (the same ``jax.device_get`` serialization seam the
+disaggregated prefill handoff uses, so bytes survive the round trip), keyed
+by pack: a *resume pack* (``rid:<id>``) holds a preempted stream's pages
+for cheap swap-in, a *prefix pack* (``px:<digest>``) holds a released
+prompt's full pages for cross-request reuse.
+
+Storage is preallocated per-leaf numpy buffers — one ``[H, *block]`` array
+per KV cache leaf, sharing ONE page-id space — so a spill is a memcpy into
+pinned rows, not a malloc per page. Capacity is a page budget
+(``serve.tier_host_pages``, an autopilot knob): a put that does not fit
+evicts least-recently-used packs; a put larger than the whole budget is
+refused (the caller falls back to plain re-prefill). Shrinking the budget
+evicts immediately but keeps the buffers — host DRAM is reclaimed lazily
+by growth, never mid-serve.
+
+Written by the scheduler thread (spill at preempt/release, fill at admit)
+and read by stats/RPC threads, so the directory is lock-guarded (pinned in
+``tools/check_concurrency.py`` REQUIRED_MODELS). The ``host_pool_slow``
+chaos seam injects swap-in latency in :meth:`get` — outside the lock, like
+every chaos sleep.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from maggy_tpu import telemetry
+from maggy_tpu.core import lockdebug
+from maggy_tpu.resilience import chaos as chaos_mod
+
+
+class HostPagePool:
+    """Bounded LRU pool of host-resident KV page packs."""
+
+    def __init__(self, capacity_pages: int, telemetry_recorder=None):
+        self.telemetry = telemetry_recorder or telemetry.get()
+        self._lock = lockdebug.lock("tier.host_pool")
+        self._capacity = max(0, int(capacity_pages))  # guarded-by: _lock
+        # per-leaf pinned buffers, one shared page-id space; rows are grown
+        # on demand up to the minted high-water mark  # guarded-by: _lock
+        self._buffers: Dict[str, np.ndarray] = {}
+        self._free: List[int] = []  # recycled page ids  # guarded-by: _lock
+        self._next_id = 0  # mint cursor  # guarded-by: _lock
+        # pack directory: key -> {"pages", "meta", "seq"}  # guarded-by: _lock
+        self._packs: Dict[str, Dict[str, Any]] = {}
+        self._seq = 0  # LRU clock  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
+        self.puts = 0  # guarded-by: _lock
+        self.gets = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+
+    # --------------------------------------------------------------- internal
+
+    def _used(self) -> int:  # guarded-by: _lock
+        return self._next_id - len(self._free)
+
+    def _evict_lru(self) -> bool:  # guarded-by: _lock
+        """Drop the least-recently-touched pack; False when empty."""
+        if not self._packs:
+            return False
+        key = min(self._packs.items(), key=lambda kv: kv[1]["seq"])[0]
+        self._free.extend(self._packs.pop(key)["pages"])
+        self.evictions += 1
+        return True
+
+    def _mint(self, n: int) -> List[int]:  # guarded-by: _lock
+        """Claim ``n`` page ids (recycled first), growing buffers to fit."""
+        ids = [self._free.pop() for _ in range(min(n, len(self._free)))]
+        while len(ids) < n:
+            ids.append(self._next_id)
+            self._next_id += 1
+        high = max(ids) + 1
+        for ks, buf in self._buffers.items():
+            if buf.shape[0] < high:
+                grown = np.zeros((high,) + buf.shape[1:], buf.dtype)
+                grown[: buf.shape[0]] = buf
+                self._buffers[ks] = grown
+        return ids
+
+    # ------------------------------------------------------------------- API
+
+    def put(self, key: str, blocks: Dict[str, np.ndarray], meta: Dict[str, Any]) -> bool:  # thread-entry — scheduler loop spills, stats threads read
+        """Spill one pack: ``blocks`` maps cache-leaf keys to ``[n, *block]``
+        page stacks (all leaves the same ``n``). Replaces any pack already
+        under ``key``; evicts LRU packs to fit; False when ``n`` exceeds the
+        whole budget (caller keeps the re-prefill fallback)."""
+        if not blocks:
+            return False
+        n = next(iter(blocks.values())).shape[0]
+        evicted = 0
+        with self._lock:
+            old = self._packs.pop(key, None)
+            if old is not None:
+                self._free.extend(old["pages"])
+            if n > self._capacity:
+                return False
+            while self._used() + n > self._capacity:
+                if not self._evict_lru():
+                    return False
+                evicted += 1
+            for ks, arr in blocks.items():
+                if ks not in self._buffers:
+                    self._buffers[ks] = np.zeros(
+                        (0,) + arr.shape[1:], arr.dtype
+                    )
+            ids = self._mint(n)
+            for ks, arr in blocks.items():
+                self._buffers[ks][ids] = arr
+            self._seq += 1
+            self._packs[key] = {
+                "pages": ids, "meta": dict(meta), "seq": self._seq,
+            }
+            self.puts += 1
+        if evicted:
+            self.telemetry.count("tier.host_evictions", evicted)
+        return True
+
+    def get(self, key: str) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
+        """Fill one pack back out: ``(blocks, meta)`` copies, or None. A hit
+        refreshes the pack's LRU recency; the pack stays resident (drop is
+        the caller's call — a resume pack dies on successful admit, a prefix
+        pack serves many requests)."""
+        with self._lock:
+            pack = self._packs.get(key)
+            if pack is None:
+                self.misses += 1
+                return None
+            self._seq += 1
+            pack["seq"] = self._seq
+            self.gets += 1
+            ids = list(pack["pages"])
+            blocks = {ks: buf[ids] for ks, buf in self._buffers.items()}
+            meta = dict(pack["meta"])
+        ch = chaos_mod.get()
+        if ch is not None:
+            delay = ch.host_pool_slow()
+            if delay > 0:
+                time.sleep(delay)  # outside the lock, like every chaos sleep
+        return blocks, meta
+
+    def has(self, key: str) -> bool:
+        with self._lock:
+            return key in self._packs
+
+    def drop(self, key: str) -> None:
+        with self._lock:
+            pack = self._packs.pop(key, None)
+            if pack is not None:
+                self._free.extend(pack["pages"])
+
+    def keys(self) -> List[str]:  # thread-entry — SSTATS threads enumerate packs
+        with self._lock:
+            return list(self._packs)
+
+    def set_capacity(self, capacity_pages: int) -> None:
+        """Autopilot seam (``serve.tier_host_pages``, safe-live): shrink
+        evicts LRU packs immediately; growth takes effect on the next put."""
+        with self._lock:
+            self._capacity = max(0, int(capacity_pages))
+            while self._used() > self._capacity:
+                if not self._evict_lru():
+                    break
+
+    def stats(self) -> Dict[str, Any]:  # thread-entry — SSTATS/monitor threads
+        with self._lock:
+            used = self._used()
+            return {
+                "host_pages_total": self._capacity,
+                "host_pages_used": used,
+                "host_pages_free": max(0, self._capacity - used),
+                "host_bytes": sum(b.nbytes for b in self._buffers.values()),
+                "resident_packs": len(self._packs),
+                "host_evictions": self.evictions,
+                "puts": self.puts,
+                "gets": self.gets,
+                "misses": self.misses,
+            }

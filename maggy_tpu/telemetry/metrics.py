@@ -60,6 +60,11 @@ GAUGES = frozenset(
         # prefix residency (serve/prefix.py residency_stats)
         "serve.prefix_resident_bytes",  # KV bytes pinned by resident prompts
         "serve.prefix_resident_count",  # resident prompts in the prefix index
+        # host-DRAM KV tier (serve/tier/, docs/serving.md "Host-DRAM page tier")
+        "tier.host_pages_free",  # unallocated host pool pages
+        "tier.host_pages_total",  # host pool capacity in pages
+        "tier.host_bytes",  # bytes held by resident host packs
+        "tier.resident_packs",  # spilled KV packs resident in host DRAM
         # device memory ledger (telemetry/memtrack.py; per-account gauges
         # ride the mem.account. dynamic prefix)
         "mem.hbm_used",  # reported device bytes in use (sim on CPU)
@@ -148,6 +153,18 @@ COUNTERS = frozenset(
         "mem.headroom_ok",  # ledger ticks with headroom above the low-water mark
         "mem.headroom_miss",  # ledger ticks under it (capacity budget burning)
         "profcap.captures",  # alert-triggered profile captures written (telemetry/profcap.py)
+        # host-DRAM KV tier (serve/tier/) + prefix-affinity routing
+        # (serve/fleet/router.py; docs/fleet.md "Fleet-global KV")
+        "tier.spills",  # streams spilled to the host tier (any kind)
+        "tier.fills",  # host packs swapped back onto the device
+        "tier.spilled_pages",  # KV pages copied device -> host
+        "tier.filled_pages",  # KV pages copied host -> device
+        "tier.prefix_spills",  # released prefixes captured as host packs
+        "tier.prefix_fills",  # admissions served from a host prefix pack
+        "tier.host_evictions",  # LRU packs dropped to make host room
+        "tier.pressure_spills",  # spills forced by a low-headroom tick
+        "tier.affinity_hits",  # routed to a replica holding the prefix
+        "tier.affinity_misses",  # no holder available; routed affinity-blind
         # autopilot online controller (autopilot/controller.py)
         "autopilot.diagnoses",  # windows classified
         "autopilot.retunes",  # guarded moves committed
@@ -164,6 +181,8 @@ HISTOGRAMS = frozenset(
         "serve.e2e_ms",  # submit -> terminal state
         "serve.drain_ms",  # async decode host drain
         "serve.handoff_ms",  # disaggregated prefill->decode handoff
+        "tier.swap_in_ms",  # host pack fetch + device scatter on admit
+        "tier.spill_ms",  # device gather + host pack write on spill
     }
 )
 
@@ -267,6 +286,10 @@ GAUGE_UNITS = {
     "serve.fragmentation": "ratio",
     "serve.prefix_resident_bytes": "bytes",
     "serve.prefix_resident_count": "count",
+    "tier.host_pages_free": "count",
+    "tier.host_pages_total": "count",
+    "tier.host_bytes": "bytes",
+    "tier.resident_packs": "count",
     "mem.hbm_used": "bytes",
     "mem.hbm_free": "bytes",
     "mem.headroom_pct": "ratio",

@@ -64,11 +64,13 @@ def test_fragmentation_empty_full_and_fragmented_pools():
     a = BlockAllocator(num_pages=9, page_size=4)
     # all-free pool: one contiguous run, no fragmentation
     f = a.fragmentation()
-    assert f == {"free_runs": 1, "largest_run": 8, "frag_ratio": 0.0}
+    assert (f["free_runs"], f["largest_run"], f["frag_ratio"]) == (1, 8, 0.0)
+    assert f["pages_pinned_shared"] == 0 and f["pages_reclaimable"] == 0
     # full pool: nothing free, ratio pinned at 0 (nothing to fragment)
     pages = a.alloc(8)
     f = a.fragmentation()
-    assert f == {"free_runs": 0, "largest_run": 0, "frag_ratio": 0.0}
+    assert (f["free_runs"], f["largest_run"], f["frag_ratio"]) == (0, 0, 0.0)
+    assert f["pages_reclaimable"] == 8
     # checkerboard release: every free page is its own run
     a.release(pages[::2])
     f = a.fragmentation()

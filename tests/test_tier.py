@@ -1,0 +1,339 @@
+"""Host-DRAM KV page tier + prefix-affinity routing (ISSUE 18): pool
+LRU/capacity semantics, fleet prefix-map bounds, spill -> swap-in byte
+parity through the engine, alias-aware allocator spill ranking, the
+``host_pool_slow`` chaos seam, and the router's affinity pick."""
+
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.parallel.sharding import unbox
+from maggy_tpu.resilience import chaos as chaos_mod
+from maggy_tpu.serve import Engine, Request, SamplingParams
+from maggy_tpu.serve.paging import BlockAllocator
+from maggy_tpu.serve.tier import FleetPrefixMap, HostPagePool, TieringPolicy
+
+CFG = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Decoder(CFG)
+    return unbox(
+        model.init(jax.random.key(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+
+
+def _blocks(n, fill, leaves=("k", "v")):
+    return {ks: np.full((n, 2, 3), fill, np.float32) for ks in leaves}
+
+
+# ----------------------------------------------------------------- host pool
+
+
+def test_host_pool_roundtrip_and_lru_eviction():
+    pool = HostPagePool(capacity_pages=4)
+    assert pool.put("a", _blocks(2, 1.0), {"kind": "resume"})
+    assert pool.put("b", _blocks(2, 2.0), {"kind": "prefix"})
+    # roundtrip is byte-exact and returns copies
+    blocks, meta = pool.get("a")
+    np.testing.assert_array_equal(blocks["k"], np.full((2, 2, 3), 1.0))
+    assert meta == {"kind": "resume"}
+    blocks["k"][:] = 99.0  # a caller scribbling on its copy
+    np.testing.assert_array_equal(pool.get("a")[0]["k"], np.full((2, 2, 3), 1.0))
+    # the get refreshed "a": a put that needs room evicts "b" (LRU), not "a"
+    assert pool.put("c", _blocks(2, 3.0), {})
+    assert pool.has("a") and pool.has("c") and not pool.has("b")
+    st = pool.stats()
+    assert st["resident_packs"] == 2 and st["host_evictions"] == 1
+    assert st["host_pages_used"] == 4 and st["host_pages_free"] == 0
+    assert pool.get("b") is None and pool.stats()["misses"] == 1
+    assert sorted(pool.keys()) == ["a", "c"]
+
+
+def test_host_pool_refuses_oversized_and_shrinks():
+    pool = HostPagePool(capacity_pages=3)
+    assert not pool.put("big", _blocks(4, 1.0), {})  # > whole budget
+    assert pool.put("a", _blocks(2, 1.0), {})
+    assert pool.put("b", _blocks(1, 2.0), {})
+    # same-key put replaces (old pages recycled, no eviction needed)
+    assert pool.put("a", _blocks(2, 5.0), {})
+    assert pool.has("b")
+    np.testing.assert_array_equal(pool.get("a")[0]["v"], np.full((2, 2, 3), 5.0))
+    # autopilot shrink evicts immediately, LRU first ("b" is older now)
+    pool.set_capacity(2)
+    assert pool.has("a") and not pool.has("b")
+    pool.set_capacity(0)
+    assert pool.stats()["resident_packs"] == 0
+    pool.drop("a")  # drop on a missing key is a no-op
+
+
+# --------------------------------------------------------------- prefix map
+
+
+def test_prefix_map_update_replaces_and_forgets():
+    m = FleetPrefixMap()
+    m.update(0, ["d1", "d2"])
+    m.update(1, ["d2"])
+    assert m.replicas_for("d1") == frozenset({0})
+    assert m.replicas_for("d2") == frozenset({0, 1})
+    # a fresh snapshot REPLACES the replica's contribution
+    m.update(0, ["d3"])
+    assert m.replicas_for("d1") == frozenset()
+    assert m.replicas_for("d2") == frozenset({1})
+    m.forget_replica(1)
+    assert m.replicas_for("d2") == frozenset()
+    snap = m.snapshot()
+    assert snap["entries"] == 1 and snap["replicas"] == {"0": 1}
+
+
+def test_prefix_map_bounded_lru():
+    m = FleetPrefixMap(max_entries=2)
+    m.update(0, ["a"])
+    m.update(1, ["b"])
+    m.update(2, ["c"])  # trims "a", the least recently reported
+    assert m.replicas_for("a") == frozenset()
+    assert m.replicas_for("b") == frozenset({1})
+    assert m.replicas_for("c") == frozenset({2})
+    assert m.snapshot()["entries"] == 2
+
+
+def test_tiering_policy_verdict_and_ledger():
+    pol = TieringPolicy(low_water_pct=0.1)
+    assert not pol.should_spill(None)  # no ledger yet -> never spill
+    assert not pol.should_spill(0.1)  # at the mark is still fine
+    assert pol.should_spill(0.09)
+    pol.note_spill(3, pressure=True)
+    pol.note_spill(2, prefix=True)
+    pol.note_fill(2, prefix=True)
+    st = pol.stats()
+    assert st["spills"] == 2 and st["spilled_pages"] == 5
+    assert st["prefix_spills"] == 1 and st["pressure_spills"] == 1
+    assert st["fills"] == 1 and st["prefix_fills"] == 1
+
+
+# ------------------------------------------------- alias-aware spill ranking
+
+
+def test_allocator_coldest_and_fragmentation_exclude_shared():
+    """Satellite regression: a prefix-aliased page (refcount >= 2) must
+    never rank spill-eligible, and the pinned/reclaimable split tiles the
+    referenced set — under churned share/release, not just fresh allocs."""
+    alloc = BlockAllocator(num_pages=8, page_size=16)
+    mine = alloc.alloc(3)
+    theirs = alloc.alloc(2)
+    alloc.share(theirs)  # aliased by a second request now
+    alloc.touch(mine, gen=5)
+    cold = alloc.coldest()
+    assert set(cold) == set(mine), "shared pages leaked into spill ranking"
+    assert set(alloc.coldest(include_shared=True)) == set(mine) | set(theirs)
+    frag = alloc.fragmentation()
+    assert frag["pages_pinned_shared"] == 2
+    assert frag["pages_reclaimable"] == 3
+    alloc.check_invariants()
+    # one sharer lets go: the pages become reclaimable and spill-eligible
+    alloc.release(theirs)
+    frag = alloc.fragmentation()
+    assert frag["pages_pinned_shared"] == 0
+    assert frag["pages_reclaimable"] == 5
+    assert set(alloc.coldest()) == set(mine) | set(theirs)
+    alloc.check_invariants()
+
+
+# -------------------------------------------------------------- chaos seam
+
+
+def test_chaos_host_pool_slow_delays_fill():
+    pool = HostPagePool(capacity_pages=2)
+    pool.put("a", _blocks(1, 1.0), {})
+    chaos_mod.install(chaos_mod.Chaos.parse("host_pool_slow:ms=80,times=1"))
+    try:
+        t0 = time.perf_counter()
+        assert pool.get("a") is not None
+        slow = time.perf_counter() - t0
+        assert slow >= 0.08, f"chaos delay not injected ({slow * 1e3:.1f}ms)"
+        t0 = time.perf_counter()
+        assert pool.get("a") is not None  # budget spent: back to fast
+        assert time.perf_counter() - t0 < 0.08
+    finally:
+        chaos_mod.reset()
+
+
+# --------------------------------------------------------- router affinity
+
+
+def _router_with_two_replicas(affinity_ms):
+    from maggy_tpu.serve.fleet import Replica, RouterConfig
+    from maggy_tpu.serve.fleet.router import Router
+
+    replicas = [
+        Replica(i, types.SimpleNamespace(role="any"), secret="s")
+        for i in range(2)
+    ]
+    return Router(
+        replicas,
+        config=RouterConfig(affinity_weight_ms=affinity_ms),
+    ), replicas
+
+
+def test_pick_replica_prefers_prefix_holder():
+    router, replicas = _router_with_two_replicas(affinity_ms=50.0)
+    # identical load on both replicas: without affinity the round-robin
+    # cursor alternates; with a digest the holder wins every time
+    router._stats_cache = {0: {}, 1: {}}
+    router.prefix_map.update(1, ["deadbeef"])
+    for _ in range(4):
+        best, proj = router._pick_replica(
+            replicas, digest="deadbeef", affinity_ms=50.0
+        )
+        assert best.index == 1
+    assert router.counters["affinity_hits"] == 4
+    # a genuinely overloaded holder still loses: the bonus is bounded
+    router._stats_cache = {
+        0: {},
+        1: {"queue_depth": 50, "num_slots": 1, "active_slots": 1},
+    }
+    best, _ = router._pick_replica(
+        replicas, digest="deadbeef", affinity_ms=50.0
+    )
+    assert best.index == 0
+    assert router.counters["affinity_misses"] == 1
+
+
+def test_pick_replica_affinity_blind_without_weight():
+    router, replicas = _router_with_two_replicas(affinity_ms=0.0)
+    router._stats_cache = {0: {}, 1: {}}
+    router.prefix_map.update(1, ["deadbeef"])
+    picks = {
+        router._pick_replica(replicas, digest="deadbeef", affinity_ms=0.0)[0].index
+        for _ in range(4)
+    }
+    assert picks == {0, 1}, "zero weight must leave round-robin untouched"
+    assert router.counters["affinity_hits"] == 0
+    assert router.counters["affinity_misses"] == 0
+
+
+# ------------------------------------------------- engine spill / swap-in
+
+
+def _drive(eng, slot, toks, n):
+    while len(toks) < n:
+        out = eng.step()
+        if slot in out.tokens:
+            toks.append(out.tokens[slot])
+        if not eng.slots.active_slots():
+            break
+    return toks
+
+
+def test_spill_swap_in_byte_parity(params):
+    """Acceptance: a stream preempted through spill_stream and re-admitted
+    from its host pack continues byte-identically with an uninterrupted
+    run — sampled (seeded), not just greedy — and a later same-prefix
+    admission fills from the released prefix pack at suffix-only cost."""
+    prompt = list(range(3, 40))  # 37 tokens, spans >2 pages
+    sp = SamplingParams(max_new=10, temperature=0.7, seed=5)
+
+    free_eng = Engine(CFG, params, num_slots=2, num_pages=24, tier=False)
+    assert free_eng.tier is None
+    r = Request(id="a", prompt=list(prompt), params=sp)
+    slot, first = free_eng.admit(r)
+    free = _drive(free_eng, slot, [first], sp.max_new)[: sp.max_new]
+
+    eng = Engine(CFG, params, num_slots=2, num_pages=24, tier=True)
+    r = Request(id="a", prompt=list(prompt), params=sp)
+    slot, first = eng.admit(r)
+    # the scheduler owns the drained-token history: each drained token is
+    # appended to the live request, which is what spill_stream captures
+    r.tokens.append(first)
+    for _ in range(4):
+        out = eng.step()
+        if slot in out.tokens:
+            r.tokens.append(out.tokens[slot])
+    out = eng.flush()
+    if slot in out.tokens:
+        r.tokens.append(out.tokens[slot])
+    toks = list(r.tokens)
+    # preempt with spill: the scheduler's order — capture, then release
+    assert eng.spill_stream(slot)
+    eng.release(slot)
+    resumed = Request(id="a", prompt=list(prompt), params=sp, tokens=list(toks))
+    slot2, first2 = eng.admit(resumed)
+    toks2 = _drive(eng, slot2, list(toks) + [first2], sp.max_new)
+    assert toks2[: sp.max_new] == free, "swap-in diverged from the free run"
+    ts = eng.tier_stats
+    assert ts["fills"] == 1 and ts["prefix_fills"] == 0, ts
+    # swap-in cost: only the undrained suffix was recomputed
+    assert eng.prefill_tokens < 2 * len(prompt)
+
+    # release leaves a prefix pack; a same-prefix admission fills from it
+    eng.release(slot2)
+    assert eng.tier_stats["prefix_spills"] >= 1
+    pt = eng.prefill_tokens
+    probe = Request(
+        id="c",
+        prompt=list(prompt) + [41, 42],
+        params=SamplingParams(max_new=3, temperature=0.0, seed=9),
+    )
+    slot3, f3 = eng.admit(probe)
+    assert eng.tier_stats["prefix_fills"] == 1
+    suffix_cost = eng.prefill_tokens - pt
+    assert suffix_cost < len(prompt), suffix_cost
+    # and the fill is correct: a tier-less engine agrees on the token
+    r4 = Request(
+        id="c",
+        prompt=list(prompt) + [41, 42],
+        params=SamplingParams(max_new=3, temperature=0.0, seed=9),
+    )
+    _, f4 = free_eng.admit(r4)
+    assert int(f3) == int(f4), (int(f3), int(f4))
+
+
+def test_stale_resume_pack_dropped(params):
+    """A resume pack whose drained-token history no longer matches the
+    re-admitted request must be dropped, not served: the admit falls back
+    (here to the prefix path or plain prefill) and stays correct."""
+    prompt = list(range(3, 30))
+    sp = SamplingParams(max_new=6, temperature=0.0, seed=1)
+    eng = Engine(CFG, params, num_slots=2, num_pages=24, tier=True)
+    r = Request(id="a", prompt=list(prompt), params=sp)
+    slot, first = eng.admit(r)
+    r.tokens.append(first)
+    for _ in range(2):
+        out = eng.step()
+        if slot in out.tokens:
+            r.tokens.append(out.tokens[slot])
+    eng.flush()
+    assert eng.spill_stream(slot)
+    eng.release(slot)
+    # re-admit with a DIFFERENT drained history than the pack captured
+    resumed = Request(
+        id="a", prompt=list(prompt), params=sp, tokens=[999, 998]
+    )
+    eng.admit(resumed)
+    assert not eng.tier.has(f"rid:{resumed.id}"), "stale pack must be dropped"
+    ts = eng.tier_stats
+    # any fill here came from the prefix fallback, never the stale pack
+    assert ts["fills"] == ts["prefix_fills"], ts
+
+
+def test_tier_env_gate_and_knob_seams(params, monkeypatch):
+    monkeypatch.setenv("MAGGY_TPU_SERVE_TIER", "0")
+    eng = Engine(CFG, params, num_slots=2, num_pages=24)
+    assert eng.tier is None and eng.tier_stats == {"enabled": False}
+    monkeypatch.delenv("MAGGY_TPU_SERVE_TIER")
+    eng = Engine(CFG, params, num_slots=2, num_pages=24)
+    assert eng.tier is not None
+    assert eng.tier_stats["host_pages_total"] == 2 * eng.num_pages
+    eng.set_tier_host_pages(7)
+    assert eng.tier_stats["host_pages_total"] == 7
+    eng.set_tier_low_water(0.2)
+    assert eng.tier_policy.low_water_pct == 0.2
+    # dense engines never attach a tier
+    dense = Engine(CFG, params, num_slots=2, paged=False)
+    assert dense.tier is None

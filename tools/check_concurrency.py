@@ -91,6 +91,9 @@ REQUIRED_MODELS: Tuple[Tuple[str, str, str], ...] = (
     (os.path.join("maggy_tpu", "telemetry", "memtrack.py"), "MemoryLedger", "_lock"),
     (os.path.join("maggy_tpu", "telemetry", "profcap.py"), "ProfileCapture", "_lock"),
     (os.path.join("maggy_tpu", "core", "driver", "base.py"), "Driver", "lock"),
+    (os.path.join("maggy_tpu", "serve", "tier", "host_pool.py"), "HostPagePool", "_lock"),
+    (os.path.join("maggy_tpu", "serve", "tier", "tiering.py"), "TieringPolicy", "_lock"),
+    (os.path.join("maggy_tpu", "serve", "tier", "prefixmap.py"), "FleetPrefixMap", "_lock"),
 )
 
 

@@ -174,6 +174,52 @@ def check_capacity_rules(alerts) -> List[str]:
     return out
 
 
+# the host-DRAM tier observability contract (docs/serving.md "Host-DRAM
+# page tier"): the tier.* series the scheduler tick and engine emit must
+# stay registered under exactly these kinds with these units — consumers
+# (monitor tier line, bench extra.fleetkv, fleet capacity aggregation) key
+# on them, and a silent re-kind (gauge -> counter) breaks every one.
+TIER_SERIES = {
+    "tier.host_pages_free": ("gauge", "count"),
+    "tier.host_pages_total": ("gauge", "count"),
+    "tier.host_bytes": ("gauge", "bytes"),
+    "tier.resident_packs": ("gauge", "count"),
+    "tier.spills": ("count", "count"),
+    "tier.fills": ("count", "count"),
+    "tier.spilled_pages": ("count", "count"),
+    "tier.filled_pages": ("count", "count"),
+    "tier.prefix_spills": ("count", "count"),
+    "tier.prefix_fills": ("count", "count"),
+    "tier.host_evictions": ("count", "count"),
+    "tier.pressure_spills": ("count", "count"),
+    "tier.affinity_hits": ("count", "count"),
+    "tier.affinity_misses": ("count", "count"),
+    "tier.swap_in_ms": ("histogram", "ms"),
+    "tier.spill_ms": ("histogram", "ms"),
+}
+
+
+def check_tier_series(registry) -> List[str]:
+    """Every pinned tier.* series is registered under the expected kind
+    and carries the expected unit."""
+    out: List[str] = []
+    units = getattr(registry, "UNITS", {})
+    for name, (kind, unit) in sorted(TIER_SERIES.items()):
+        allowed = registry.BY_KIND.get(kind, frozenset())
+        if name not in allowed:
+            out.append(
+                f"tier series {name!r} must be registered as a {kind} "
+                "in telemetry/metrics.py"
+            )
+            continue
+        got = units.get(name)
+        if got != unit:
+            out.append(
+                f"tier series {name!r}: unit {got!r}, expected {unit!r}"
+            )
+    return out
+
+
 def _receiver_is_telemetry(expr: ast.AST) -> bool:
     """True when the call receiver plausibly is a telemetry recorder: some
     identifier in its chain contains 'tel'. Keeps ``"abc".count("a")`` and
@@ -263,6 +309,9 @@ def main(argv=None) -> int:
     violations: List[Tuple[str, int, str]] = []
     reg_path = os.path.join(repo, "maggy_tpu", "telemetry", "metrics.py")
     violations.extend((reg_path, 0, what) for what in check_units(registry))
+    violations.extend(
+        (reg_path, 0, what) for what in check_tier_series(registry)
+    )
     alerts_path = os.path.join(repo, "maggy_tpu", "telemetry", "alerts.py")
     violations.extend(
         (alerts_path, 0, what) for what in check_alert_registry(alerts, registry)
