@@ -428,11 +428,22 @@ class RouterTarget:
 
     def current(self) -> Dict[str, Any]:
         cfg = self.router.config
-        return {
+        values = {
             "fleet.admission": cfg.admission,
             "fleet.slo_ttft_ms": cfg.slo_ttft_ms,
             "fleet.affinity_weight": cfg.affinity_weight_ms,
         }
+        scaler = getattr(self.router, "autoscaler", None)
+        if scaler is not None:
+            values.update(
+                {
+                    "fleet.min_replicas": scaler.config.min_replicas,
+                    "fleet.max_replicas": scaler.config.max_replicas,
+                    "fleet.scale_cooldown_s": scaler.config.scale_cooldown_s,
+                    "fleet.target_util": scaler.config.target_util,
+                }
+            )
+        return values
 
     def pending(self) -> bool:
         return False
@@ -451,4 +462,31 @@ class RouterTarget:
             if knob == "fleet.affinity_weight":
                 cfg.affinity_weight_ms = float(value)
                 return True
+        scaler = getattr(self.router, "autoscaler", None)
+        if scaler is None:
+            return False
+        # autoscaler bounds move as a pair-consistent config: the scaler
+        # reads them fresh each decision tick, so the change is instant
+        scfg = scaler.config
+        if knob == "fleet.min_replicas":
+            v = int(value)
+            if v < 1 or v > scfg.max_replicas:
+                return False
+            scfg.min_replicas = v
+            return True
+        if knob == "fleet.max_replicas":
+            v = int(value)
+            if v < scfg.min_replicas:
+                return False
+            scfg.max_replicas = v
+            return True
+        if knob == "fleet.scale_cooldown_s":
+            scfg.scale_cooldown_s = max(0.0, float(value))
+            return True
+        if knob == "fleet.target_util":
+            v = float(value)
+            if not scfg.low_util < v <= 1.0:
+                return False
+            scfg.target_util = v
+            return True
         return False

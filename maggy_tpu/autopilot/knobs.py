@@ -198,6 +198,31 @@ KNOBS = {
             "affinity-blind routing; brownout level >= 2 zeroes it)",
             lo=0.0, hi=10_000.0,
         ),
+        # ---- fleet autoscaler (docs/fleet.md "Autoscaling")
+        Knob(
+            "fleet.min_replicas", "int", "fleet", True,
+            "autoscaler floor: scale-in never drains below this count",
+            lo=1, hi=64,
+        ),
+        Knob(
+            "fleet.max_replicas", "int", "fleet", True,
+            "autoscaler ceiling: scale-out pressure past it raises the "
+            "fleet.at_capacity gauge instead of spawning",
+            lo=1, hi=64,
+        ),
+        Knob(
+            "fleet.scale_cooldown_s", "float", "fleet", True,
+            "minimum seconds between scale events (flap prevention: a "
+            "burst's edge must not thrash the fleet)",
+            lo=0.0, hi=3600.0,
+        ),
+        Knob(
+            "fleet.target_util", "float", "fleet", True,
+            "fleet slot-utilization ceiling the autoscaler holds: "
+            "sustained util above it scales out, scale-in only when the "
+            "survivors would stay below it",
+            lo=0.05, hi=0.95,
+        ),
     )
 }
 

@@ -527,6 +527,29 @@ def render_status(status: dict, width: int = 78) -> str:
         agg.extend(_latency_parts(sv))
         lines.extend(_wrap_parts(agg, width))
         lines.extend(line[:width] for line in _autopilot_line(sv))
+        autoscale = sv.get("autoscale")
+        if autoscale:
+            n_up = sum(
+                1
+                for row in fleet.get("replicas") or []
+                if row.get("state") in ("up", "draining", "quarantined")
+            )
+            last = autoscale.get("last_event") or {}
+            lines.append(
+                (
+                    f"autoscale: {n_up} replicas"
+                    f" [{autoscale.get('min_replicas', '?')}"
+                    f"..{autoscale.get('max_replicas', '?')}]"
+                    f"  phase={autoscale.get('phase', '?')}"
+                    + (
+                        f"  last={last.get('event', '')}"
+                        f"({last.get('reason', '')})"
+                        if last
+                        else ""
+                    )
+                    + ("  AT-CAPACITY" if autoscale.get("at_capacity") else "")
+                )[:width]
+            )
         lines.extend(_alert_lines(sv, width))
         lines.extend(_trend_lines(sv, width))
         for row in fleet.get("replicas") or []:
@@ -534,9 +557,12 @@ def render_status(status: dict, width: int = 78) -> str:
                 row.get("active_slots", 0), max(row.get("num_slots", 1), 1),
                 width=10,
             )
-            tag = {"up": "up", "quarantined": "QUAR", "dead": "DEAD"}.get(
-                row.get("state"), row.get("state", "?")
-            )
+            tag = {
+                "up": "up",
+                "quarantined": "QUAR",
+                "draining": "DRAI",
+                "dead": "DEAD",
+            }.get(row.get("state"), row.get("state", "?"))
             role = row.get("role")
             lines.append(
                 (

@@ -35,6 +35,12 @@ Seams (all zero-cost when no plan is installed):
   ``host_pool_slow`` per pack fill — swap-in latency lands in admission
   TTFT, exercising the tier's degraded-but-correct path (docs/serving.md
   "Host-DRAM page tier").
+* The fleet autoscaler (``serve/fleet/autoscale.py``) consults
+  ``replica_spawn_slow`` before warming a spawned replica (slow host
+  acquisition / cold compile cache — the probation gate must hold) and
+  ``replica_kill_mid_drain`` each drain tick (a scale-in victim dying
+  mid-drain must fall back to the requeue-on-death path; docs/fleet.md
+  "Autoscaling").
 * ``Trainer.fit`` consults ``slice_drop`` / ``slice_rejoin`` each step when
   running under an elastic membership monitor — a matching ``slice_drop``
   raises :class:`~maggy_tpu.resilience.membership.SliceLost` (the slice's
@@ -83,6 +89,8 @@ KINDS = frozenset(
         "replica_slow",  # gray failure: delay replica N's admissions by ms=K
         "tenant_burst",  # multiply tenant T's offered load by mult=M (loadgen)
         "host_pool_slow",  # delay host-DRAM KV tier swap-ins by ms=K
+        "replica_spawn_slow",  # delay an autoscaler spawn's warm-up by secs=K
+        "replica_kill_mid_drain",  # kill replica N while its drain is in progress
     }
 )
 
@@ -189,6 +197,25 @@ class Chaos:
         router's pump consults it only while the replica is mid-stream, so
         a matching rule always exercises requeue-to-survivors)."""
         return self.fire("replica_kill", replica=replica) is not None
+
+    def replica_spawn_slow(self, replica: Any) -> float:
+        """Seconds to delay a freshly spawned replica's warm-up (0.0 =
+        none). The autoscaler's warm worker consults it before building
+        the new engine, standing in for a slow host acquisition or a cold
+        compile cache — the probation gate and warm timeout must hold the
+        replica out of dispatch the whole time:
+        ``replica_spawn_slow:replica=2,secs=1``."""
+        fault = self.fire("replica_spawn_slow", replica=replica)
+        return fault.arg if fault is not None else 0.0
+
+    def replica_kill_mid_drain(self, replica: Any) -> bool:
+        """True when this replica should drop dead mid-drain. The
+        autoscaler's drain loop consults it each tick while the victim
+        still holds in-flight streams, so a matching rule always lands
+        between dispatch-stop and retire — exercising the fallback from
+        graceful drain to the router's requeue-on-death path:
+        ``replica_kill_mid_drain:replica=1``."""
+        return self.fire("replica_kill_mid_drain", replica=replica) is not None
 
     def replica_slow(self, replica: Any) -> float:
         """Seconds of gray-failure latency to inject into this replica's

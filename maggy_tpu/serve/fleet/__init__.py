@@ -22,6 +22,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from maggy_tpu.serve.fleet.autoscale import (  # noqa: F401
+    AutoscaleConfig,
+    Autoscaler,
+)
 from maggy_tpu.serve.fleet.prefill import (  # noqa: F401
     PrefillWorker,
     PrefillWorkerError,
@@ -38,6 +42,8 @@ from maggy_tpu.serve.fleet.router import (  # noqa: F401
 )
 
 __all__ = [
+    "AutoscaleConfig",
+    "Autoscaler",
     "PrefillWorker",
     "PrefillWorkerError",
     "Replica",
@@ -59,6 +65,7 @@ def launch_fleet(
     host: str = "127.0.0.1",
     telemetry_recorder=None,
     autopilot=None,
+    autoscale=None,
     prefill_replicas: int = 0,
     **config_kwargs,
 ) -> Router:
@@ -66,7 +73,10 @@ def launch_fleet(
     leases carved like trial sub-slices). Call ``router.start()`` to serve;
     extra kwargs go to :class:`RouterConfig` (``slo_ttft_ms=...`` etc.);
     ``autopilot`` attaches an online controller to the router
-    (docs/autotune.md "Continuous tuning").
+    (docs/autotune.md "Continuous tuning"); ``autoscale`` (True or an
+    :class:`AutoscaleConfig`) attaches the fleet autoscaler, which grows
+    and shrinks the replica pool between its min/max bounds with
+    drain-safe scale events (docs/fleet.md "Autoscaling").
 
     ``prefill_replicas > 0`` builds a DISAGGREGATED fleet (docs/fleet.md):
     ``replicas`` decode-role replicas plus that many prefill-role replicas —
@@ -99,5 +109,6 @@ def launch_fleet(
         name=name,
         telemetry_recorder=telemetry_recorder,
         autopilot=autopilot,
+        autoscale=autoscale,
     )
     return router

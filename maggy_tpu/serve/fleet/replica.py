@@ -176,6 +176,36 @@ class CircuitBreaker:
                 self._probe_inflight = False
                 self._probe_rid = None
 
+    def reset(self) -> None:
+        """Back to a pristine CLOSED breaker. For respawned replicas: the
+        new engine shares nothing with the dead one, so the scoring window
+        and any open/half-open state built from pre-death latency samples
+        are stale — carrying them over would re-open a healthy replica on
+        its predecessor's ghosts (the router also drops the replica's
+        SeriesStore for the same reason)."""
+        with self._lock:
+            self._state = BREAKER_CLOSED
+            self._slow_streak = 0
+            self._opened_ts = None
+            self._close_below_ms = 0.0
+            self._probe_inflight = False
+            self._probe_rid = None
+
+    def begin_probation(self, close_below_ms: float) -> None:
+        """Half-open-style admission gate for a freshly warmed replica
+        (autoscaler scale-up): start in HALF_OPEN so the dispatch loop's
+        probation-first path routes one canary request at a time, and an
+        observed TTFT at or under ``close_below_ms`` closes the breaker —
+        only then does the replica take weighted traffic. A slow canary
+        re-opens it, exactly like gray-failure probation."""
+        with self._lock:
+            self._state = BREAKER_HALF_OPEN
+            self._slow_streak = 0
+            self._opened_ts = None
+            self._close_below_ms = float(close_below_ms)
+            self._probe_inflight = False
+            self._probe_rid = None
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {

@@ -28,6 +28,14 @@ dashboard) points at a fleet unchanged. Behind the verbs:
   ``max_restarts``. The chaos seam
   (``MAGGY_TPU_CHAOS="replica_kill:replica=N"``) kills a busy replica
   deterministically so all of this is testable on one CPU.
+* **Autoscaling** (opt-in, docs/fleet.md "Autoscaling"). An
+  :class:`~maggy_tpu.serve.fleet.autoscale.Autoscaler` ticked by the pump
+  grows/shrinks the fleet from its own time-series: scale-up admits a
+  warmed replica behind a half-open probation gate
+  (:meth:`admit_replica`); scale-down drains a victim — dispatch stops
+  (:meth:`begin_drain`), in-flight waves finish or are spilled and
+  requeued to survivors (:meth:`spill_and_requeue`), then the replica and
+  every per-replica trace of it are removed (:meth:`retire_replica`).
 
 * **Disaggregated prefill/decode.** Replicas tagged ``role="prefill"``
   (:class:`~maggy_tpu.serve.fleet.replica.ReplicaSpec`) never receive
@@ -308,6 +316,7 @@ class Router:
         name: str = "maggy-fleet",
         telemetry_recorder=None,
         autopilot=None,
+        autoscale=None,
     ):
         self.config = config or RouterConfig()
         self.config.validate()
@@ -360,6 +369,14 @@ class Router:
         self._pending: deque = deque()  # rids; requeues go left, fresh right
         self._stats_cache: Dict[int, Dict[str, Any]] = {}
         self._down_handled: set = set()  # replica idx whose death was requeued
+        # replicas mid-retirement (autoscaler drain protocol): no new
+        # dispatch, still polled so in-flight waves finish  # guarded-by: _lock
+        self._draining: set = set()
+        # next fleet index for autoscaler-spawned replicas (indices are
+        # never reused; they key breakers, stores, the prefix map)
+        self._next_index = (
+            max((r.index for r in self.replicas), default=-1) + 1
+        )  # guarded-by: _lock
         self._restarts_used = 0
         self._rr = 0  # round-robin tie-break cursor
         self.counters: Dict[str, int] = {
@@ -449,6 +466,28 @@ class Router:
         ):
             self._rpc.register_callback(verb, handler)
         self._rpc.register_metrics(self._metrics_body)
+        # fleet autoscaler (docs/fleet.md "Autoscaling"): ticked by the
+        # pump after each metrics tick; drain/admit seams below are its
+        # only write surface into the fleet
+        self.autoscaler = None
+        if autoscale is not None and autoscale is not False:
+            from maggy_tpu.serve.fleet.autoscale import (
+                AutoscaleConfig,
+                Autoscaler,
+            )
+
+            self.autoscaler = (
+                autoscale
+                if isinstance(autoscale, Autoscaler)
+                else Autoscaler(
+                    self,
+                    config=(
+                        autoscale
+                        if isinstance(autoscale, AutoscaleConfig)
+                        else None
+                    ),
+                )
+            )
 
     @property
     def secret(self) -> str:
@@ -510,15 +549,26 @@ class Router:
 
     def _healthy(self) -> List[Replica]:
         """Dispatch targets: healthy decode-capable replicas (prefill-only
-        replicas are PrefillWorkers, never SUBMIT targets)."""
+        replicas are PrefillWorkers, never SUBMIT targets; draining
+        replicas finish their waves but take nothing new)."""
         now = time.time()
         return [
             r
             for r in self.replicas
             if r.state == UP
             and getattr(r.spec, "role", "any") != "prefill"
+            and r.index not in self._draining
             and not self.quarantine.is_quarantined(r.index, now)
         ]
+
+    def _replica(self, index: int) -> Optional[Replica]:
+        """Replica by fleet index. Positional indexing into
+        ``self.replicas`` is wrong once the autoscaler has retired or
+        added replicas — indices are sparse and never reused."""
+        for r in self.replicas:
+            if r.index == index:
+                return r
+        return None
 
     def _pick_replica(  # guarded-by: _lock
         self,
@@ -540,10 +590,24 @@ class Router:
             if digest is not None and affinity_ms > 0
             else frozenset()
         )
+        # dispatches the replica hasn't reported yet (routed, no poll
+        # snapshot) count against its queue now — within one dispatch
+        # sweep the stats cache is frozen, so without this correction the
+        # whole pending queue dumps on whichever replica reported least
+        # loaded at the last probe tick
+        unseen: Dict[int, int] = {}
+        for e in self._entries.values():
+            if e.state == ROUTED and e.snapshot is None and not e.done():
+                unseen[e.replica] = unseen.get(e.replica, 0) + 1
         scored = []
         for offset in range(len(healthy)):
             r = healthy[(self._rr + offset) % len(healthy)]
             stats = self._stats_cache.get(r.index, {})
+            extra = unseen.get(r.index, 0)
+            if extra:
+                stats = dict(
+                    stats, queue_depth=stats.get("queue_depth", 0) + extra
+                )
             proj = projected_ttft_ms(stats, cfg.default_service_ms)
             if r.index in holders:
                 proj -= affinity_ms
@@ -558,6 +622,216 @@ class Router:
                 self.counters["affinity_misses"] += 1
                 self.telemetry.count("tier.affinity_misses")
         return best, proj
+
+    # ------------------------------------------------------- autoscaler seams
+    # (pump-thread internals, invoked via Autoscaler.tick — the drain
+    # protocol's write surface; like the rest of the pump machinery, the
+    # pump thread is the only writer and compound writes hold _lock)
+
+    def allocate_index(self) -> int:
+        """Mint a fleet index for a new replica. Indices are never
+        reused: every per-replica structure (breakers, SeriesStores, the
+        prefix map) keys on them."""
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+            return index
+
+    def admit_replica(self, replica: Replica, probation: bool = True) -> None:
+        """Add a started, warmed replica to the dispatch set. Its breaker
+        and quarantine state are built fresh — admission on stale
+        pre-spawn samples is the bug class the respawn path also guards
+        against. With ``probation`` the breaker starts HALF_OPEN, so the
+        dispatch loop's probation-first path routes one canary request at
+        a time; only an observed TTFT under the close bar (the TTFT SLO,
+        or 10x the service prior without one) closes it and lets the
+        replica take weighted traffic (docs/fleet.md "Autoscaling")."""
+        cfg = self.config
+        breaker = CircuitBreaker(
+            replica.index, trips=cfg.breaker_trips,
+            cooldown_s=cfg.breaker_cooldown_s,
+        )
+        if probation:
+            close_below = (
+                cfg.slo_ttft_ms
+                if cfg.slo_ttft_ms is not None
+                else 10.0 * cfg.default_service_ms
+            )
+            breaker.begin_probation(close_below)
+        self.quarantine.record_success(replica.index)
+        with self._lock:
+            # indices are never reused, even when the replica was built
+            # outside allocate_index()
+            self._next_index = max(self._next_index, replica.index + 1)
+            self.replicas = self.replicas + [replica]
+            self.breakers[replica.index] = breaker
+            self.retry_budgets[replica.index] = RetryBudget(
+                cfg.retry_budget, cfg.retry_budget_window_s
+            )
+            self._stats_cache.pop(replica.index, None)
+            self.replica_metrics.pop(replica.index, None)
+            self._down_handled.discard(replica.index)
+            self._draining.discard(replica.index)
+            if getattr(replica.spec, "role", "any") == "prefill":
+                self.prefill_workers = self.prefill_workers + [
+                    PrefillWorker(replica)
+                ]
+        self.log(
+            f"replica {replica.index} admitted"
+            f"{' (probation)' if probation else ''}"
+        )
+
+    def begin_drain(self, index: int) -> None:
+        """Drain protocol step 1: stop dispatching to the replica without
+        touching its liveness. Routed entries keep polling, so in-flight
+        waves finish on the victim; the death path skips respawn for a
+        draining replica (retirement is deliberate, not a failure)."""
+        with self._lock:
+            self._draining.add(index)
+        self.log(f"replica {index} draining (dispatch stopped)")
+
+    def inflight_on(self, index: int) -> int:
+        """Streams still live on a replica (the drain's exit condition)."""
+        with self._lock:
+            return sum(
+                1
+                for e in self._entries.values()
+                if e.replica == index and e.state == ROUTED and not e.done()
+            )
+
+    def spill_and_requeue(self, index: int) -> int:
+        """Drain protocol step 2 (when the grace expires): move the
+        victim's remaining streams to survivors. Each downstream request
+        is cancelled — the victim's scheduler frees its pages, and
+        reusable prefix KV spills through the host tier seam on release
+        (docs/serving.md "Host-DRAM page tier") — and the router entry is
+        requeued ahead of fresh arrivals. Byte-identical by construction:
+        engine output is a pure function of (params, prompt, seed), so
+        the replay on a survivor regenerates exactly the tokens the
+        victim would have produced."""
+        replica = self._replica(index)
+        moved: List[Tuple[RouteEntry, Optional[str]]] = []
+        with self._lock:
+            for entry in self._entries.values():
+                if (
+                    entry.replica == index
+                    and entry.state == ROUTED
+                    and not entry.done()
+                ):
+                    remote = entry.remote_id
+                    entry.state = REQUEUED
+                    entry.replica = None
+                    entry.remote_id = None
+                    entry.snapshot = None
+                    entry.resubmits += 1
+                    entry.not_before_ts = None
+                    self._pending.appendleft(entry.rid)
+                    self.counters["requeued"] += 1
+                    moved.append((entry, remote))
+        for entry, remote in moved:
+            if replica is not None and replica.state == UP and remote:
+                try:
+                    replica.client.cancel(remote)
+                except (RpcError, OSError):
+                    pass  # victim half-gone: requeue already happened
+            self.telemetry.event(
+                "req.requeued", trace=entry.trace, rid=entry.rid,
+                replica=index, resubmits=entry.resubmits,
+            )
+        if moved:
+            self.telemetry.count("fleet.requeued", len(moved))
+        return len(moved)
+
+    def rebalance_excess(self) -> int:
+        """Shed routed-but-unstarted backlog back into the shared queue
+        when capacity comes online (a scale-up's probation breaker
+        closes, or a gray replica recovers). Work dispatched before the
+        fleet widened stays pinned to the replica that absorbed it — the
+        victim of the very overload that triggered the scale-out — so a
+        fresh replica would otherwise only ever see new arrivals. Each
+        replica keeps two waves per slot; anything beyond that which has
+        not produced a token yet is cancelled downstream and requeued
+        (byte-identical for the same reason the drain spill is: output
+        is a pure function of (params, prompt, seed))."""
+        moved: List[Tuple[RouteEntry, Replica, Optional[str]]] = []
+        with self._lock:
+            per: Dict[int, List[RouteEntry]] = {}
+            for e in self._entries.values():
+                if (
+                    e.state == ROUTED
+                    and not e.done()
+                    and e.replica is not None
+                    and (
+                        e.snapshot is None
+                        or not e.snapshot.get("n_tokens", 0)
+                    )
+                ):
+                    per.setdefault(e.replica, []).append(e)
+            for index, entries in per.items():
+                replica = self._replica(index)
+                if replica is None or index in self._draining:
+                    continue
+                keep = 2 * int(getattr(replica.spec, "num_slots", 1) or 1)
+                if len(entries) <= keep:
+                    continue
+                # oldest stay (they are next to start); the tail moves,
+                # requeued ahead of fresh arrivals in its original order
+                entries.sort(key=lambda e: e.submitted_ts)
+                for entry in reversed(entries[keep:]):
+                    remote = entry.remote_id
+                    entry.state = REQUEUED
+                    entry.replica = None
+                    entry.remote_id = None
+                    entry.snapshot = None
+                    entry.resubmits += 1
+                    entry.not_before_ts = None
+                    self._pending.appendleft(entry.rid)
+                    self.counters["requeued"] += 1
+                    moved.append((entry, replica, remote))
+        for entry, replica, remote in moved:
+            if replica.state == UP and remote:
+                try:
+                    replica.client.cancel(remote)
+                except (RpcError, OSError):
+                    pass  # source replica will drop it at its own pace
+            self.telemetry.event(
+                "req.requeued", trace=entry.trace, rid=entry.rid,
+                replica=replica.index, resubmits=entry.resubmits,
+            )
+        if moved:
+            self.telemetry.count("fleet.requeued", len(moved))
+            self.log(f"rebalanced {len(moved)} queued requests fleet-wide")
+        return len(moved)
+
+    def retire_replica(self, replica: Replica, timeout: float = 30.0) -> None:
+        """Drain protocol step 3: remove the replica from the fleet for
+        good — the graceful twin of the death path. Stops it cleanly when
+        still UP, then forgets every per-replica trace: FleetPrefixMap
+        entries, breaker, retry budget, stats cache, quarantine state, and
+        the per-replica SeriesStore. A retired replica must leave no
+        ghosts in FSTATS aggregates (regression-tested)."""
+        index = replica.index
+        if replica.state == UP:
+            replica.stop(drain=True, timeout=timeout)
+        self.prefix_map.forget_replica(index)
+        self.quarantine.record_success(index)
+        with self._lock:
+            self.replicas = [r for r in self.replicas if r.index != index]
+            self.prefill_workers = [
+                w for w in self.prefill_workers if w.index != index
+            ]
+            self.breakers.pop(index, None)
+            self.retry_budgets.pop(index, None)
+            self._stats_cache.pop(index, None)
+            self.replica_metrics.pop(index, None)
+            self._down_handled.discard(index)
+            self._draining.discard(index)
+        self.log(f"replica {index} retired")
+
+    def sweep_now(self) -> None:
+        """Run the down-replica sweep immediately (the pump's own sweep
+        already ran this iteration when a chaos kill lands mid-drain)."""
+        self._sweep_down_replicas()
 
     # ----------------------------------------------------------------- verbs
     # (event-loop thread: lock-guarded host state only, no sockets)
@@ -783,6 +1057,8 @@ class Router:
             }
             if quarantined:
                 row["state"] = "quarantined"
+            if r.state == UP and r.index in self._draining:
+                row["state"] = "draining"
             table.append(row)
             if r.state == UP and not quarantined:
                 agg["queue_depth"] += stats.get("queue_depth", 0)
@@ -897,6 +1173,8 @@ class Router:
             }
         if self.autopilot is not None:
             agg["autopilot"] = self.autopilot.status()
+        if self.autoscaler is not None:
+            agg["autoscale"] = self.autoscaler.snapshot()
         # one residency row per distinct prefix digest: the same system
         # prompt resident on three replicas is ONE fleet anchor pinning
         # 3x the bytes, not three anchors
@@ -969,6 +1247,8 @@ class Router:
                 for r in self.replicas
             }
             pending = len(self._pending)
+            draining = len(self._draining)
+            n_replicas = sum(1 for r in self.replicas if r.state != DEAD)
         latency_all: Dict[str, List[Dict[str, Any]]] = {}
         slo_ok_sum = 0
         slo_miss_sum = 0
@@ -976,6 +1256,16 @@ class Router:
         fleet_gauges = {
             "serve.queue_depth": float(pending),
             "fleet.healthy_replicas": float(len(self._healthy())),
+            # capacity-loop surfaces (docs/fleet.md "Autoscaling"): fleet
+            # size, replicas mid-drain, and scale-out pressure pinned at
+            # max_replicas (the alert.fleet_at_capacity input)
+            "fleet.replicas": float(n_replicas),
+            "fleet.draining": float(draining),
+            "fleet.at_capacity": (
+                1.0
+                if self.autoscaler is not None and self.autoscaler.at_capacity()
+                else 0.0
+            ),
         }
         tokens_per_sec = 0.0
         # fleet capacity accumulators: heat/residency sum across replicas;
@@ -1121,6 +1411,10 @@ class Router:
         )
         fleet_gauges["fleet.breaker_open"] = float(open_count)
         self.telemetry.gauge("fleet.breaker_open", float(open_count))
+        self.telemetry.gauge("fleet.replicas", fleet_gauges["fleet.replicas"])
+        self.telemetry.gauge(
+            "fleet.at_capacity", fleet_gauges["fleet.at_capacity"]
+        )
         self.metrics.ingest(now, gauges=fleet_gauges, counters=counters, hists=merged_hists)
         self.alerts.evaluate(now)
         self.telemetry.gauge("alerts.firing", float(len(self.alerts.firing())))
@@ -1234,6 +1528,8 @@ class Router:
                 self._poll_routed()
                 if self.autopilot is not None:
                     self.autopilot.maybe_sample(time.time())
+                if self.autoscaler is not None and not self._closing:
+                    self.autoscaler.tick(time.time())
             except Exception as e:  # noqa: BLE001 - pump must survive anything
                 self.log(f"pump error: {type(e).__name__}: {e}")
             self._stop.wait(self.config.pump_interval_s)
@@ -1315,8 +1611,12 @@ class Router:
             )
         with self._lock:
             self._stats_cache.pop(replica.index, None)
+            # a draining replica's death is the kill-mid-drain fallback:
+            # its requeue above is the recovery, retirement finishes in
+            # the autoscaler — never respawn what we were removing
             respawn = (
                 replica.state == DEAD
+                and replica.index not in self._draining
                 and self._restarts_used < self.config.max_restarts
             )
             if respawn:
@@ -1337,8 +1637,16 @@ class Router:
                 )
                 return
             self.quarantine.record_success(replica.index)
+            # the respawned stack shares nothing with the dead one: a
+            # breaker window or SeriesStore built from pre-death latency
+            # samples would re-open/re-trip the fresh replica on its
+            # predecessor's ghosts (regression-tested)
+            breaker = self.breakers.get(replica.index)
+            if breaker is not None:
+                breaker.reset()
             with self._lock:
                 self._down_handled.discard(replica.index)
+                self.replica_metrics.pop(replica.index, None)
                 self.counters["respawned"] += 1
             self.log(
                 f"replica {replica.index} respawned at {addr[0]}:{addr[1]} "
@@ -1595,8 +1903,8 @@ class Router:
                 if e.state == ROUTED and not e.done()
             ]
         for rid, idx, remote_id, want_cancel, cancel_sent in live:
-            replica = self.replicas[idx]
-            if replica.state != UP:
+            replica = self._replica(idx)
+            if replica is None or replica.state != UP:
                 continue  # the down-sweep requeues; don't poke a closed port
             try:
                 if want_cancel and not cancel_sent:
@@ -1650,6 +1958,10 @@ class Router:
                         f"breaker CLOSED on replica {idx} (probe ttft "
                         f"{snap['ttft_ms']:.0f}ms)"
                     )
+                    # capacity just came online: spread any backlog that
+                    # was pinned to the overloaded peers before this
+                    # replica could take weighted traffic
+                    self.rebalance_excess()
                 elif verdict == "reopened":
                     self.telemetry.count("fleet.breaker_opened")
                     self.log(

@@ -115,6 +115,62 @@ class Arrival:
     seq: int  # global arrival index after the time-sort (stable tiebreak)
 
 
+def diurnal_burst_spec(
+    *,
+    seed: int = 7,
+    duration_s: float = 12.0,
+    base_rps: float = 6.0,
+    burst_mult: float = 4.0,
+    diurnal_amp: float = 0.6,
+    max_new: int = 6,
+) -> TrafficSpec:
+    """The canned capacity-planning scenario: a diurnal swell with a
+    correlated burst pinned to the swell's crest.
+
+    This is the offered load the fleet autoscaler is sized against
+    (``bench.py extra.autoscale``, ``tests/test_autoscale.py``): quiet
+    shoulders where scale-in should engage, a crest that demands
+    scale-out, and a mid-crest burst that drives the brownout ladder to
+    level >= 2. Two tenants (a standard-class majority with shared prefix
+    stems and a best-effort bulk minority) keep the QoS machinery honest
+    during scale events. Same arguments = the same schedule, byte for
+    byte (docs/fleet.md, "Autoscaling").
+    """
+    return TrafficSpec(
+        seed=seed,
+        duration_s=duration_s,
+        base_rps=base_rps,
+        tenants=(
+            TenantMix(
+                tenant="web",
+                qos="standard",
+                weight=3.0,
+                prompt_len=12,
+                prefix_len=6,
+                n_prefixes=4,
+                max_new=max_new,
+            ),
+            TenantMix(
+                tenant="bulk",
+                qos="best_effort",
+                weight=1.0,
+                prompt_len=10,
+                max_new=max_new,
+            ),
+        ),
+        diurnal_amp=diurnal_amp,
+        # one full swell per run; the burst sits on the crest (t = T/4)
+        diurnal_period_s=duration_s,
+        bursts=(
+            Burst(
+                start_s=duration_s / 4,
+                duration_s=duration_s / 6,
+                mult=burst_mult,
+            ),
+        ),
+    )
+
+
 def _rate_at(spec: TrafficSpec, t: float, mix: TenantMix, frac: float) -> float:
     """This tenant's instantaneous requests/sec at offset ``t``."""
     rate = spec.base_rps * frac

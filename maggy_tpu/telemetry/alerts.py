@@ -114,6 +114,17 @@ RULES: Tuple[Rule, ...] = (
         scope="fleet",
     ),
     Rule(
+        name="alert.fleet_at_capacity",
+        summary="scale-out pressure pinned at max_replicas; brownout is the only relief",
+        kind="threshold",
+        metric="fleet.at_capacity",
+        op=">",
+        threshold=0.0,
+        for_s=5.0,
+        severity="warning",
+        scope="fleet",
+    ),
+    Rule(
         name="alert.ttft_slo_burn",
         summary="TTFT SLO error budget burning in short and long windows",
         kind="burn_rate",

@@ -75,6 +75,10 @@ GAUGES = frozenset(
         "fleet.healthy_replicas",
         "fleet.breaker_open",  # circuit breakers currently open (gray replicas)
         "fleet.brownout_level",  # degradation ladder position (0=normal..3=shed)
+        # capacity loop (serve/fleet/autoscale.py; docs/fleet.md "Autoscaling")
+        "fleet.replicas",  # fleet size (non-dead replicas, any role)
+        "fleet.draining",  # replicas mid-retirement (no dispatch, still polled)
+        "fleet.at_capacity",  # 1 while scale-out pressure is pinned at max_replicas
         "serve.handoff_ms",  # prefill->decode KV handoff latency
         # autotuner (tune/)
         "tune.candidates",
@@ -115,6 +119,7 @@ COUNTERS = frozenset(
         "fleet.retry_deferred",  # requeues delayed by an exhausted retry budget
         "fleet.breaker_opened",  # breaker transitions into OPEN (incl. re-opens)
         "fleet.breaker_closed",  # half-open probes that verified recovery
+        "fleet.scale_events",  # autoscaler decisions applied (up + down)
         # per-QoS-class scheduler accounting (serve/scheduler.py); the class
         # tail is the closed qos set, spelled out so the lint sees every name
         "serve.qos.admitted.premium",
@@ -183,6 +188,7 @@ HISTOGRAMS = frozenset(
         "serve.handoff_ms",  # disaggregated prefill->decode handoff
         "tier.swap_in_ms",  # host pack fetch + device scatter on admit
         "tier.spill_ms",  # device gather + host pack write on spill
+        "fleet.drain_ms",  # scale-in drain: dispatch stop -> replica retired
     }
 )
 
@@ -217,6 +223,17 @@ EVENTS = frozenset(
         "autopilot.committed",
         "autopilot.rollback",
         "autopilot.reconfigure_failed",
+        # fleet autoscaler decision journal (serve/fleet/autoscale.py;
+        # docs/fleet.md "Autoscaling") — the auditable capacity loop:
+        # decisions, safe-event milestones, guarded commits, auto-reverts
+        "fleet.scale.up",  # scale-out decided; spawn + warm started
+        "fleet.scale.down",  # scale-in decided; victim drain started
+        "fleet.scale.admitted",  # warmed replica entered probation dispatch
+        "fleet.scale.retired",  # drained (or kill-fallback) replica removed
+        "fleet.scale.committed",  # post-scale guard window held
+        "fleet.scale.rollback",  # guard regressed; event auto-reverted
+        "fleet.scale.guard_extended",  # regression explained by ongoing storm
+        "fleet.scale.blocked",  # decision suppressed (at max / warm failed)
         # alert rule transitions (telemetry/alerts.py; the rule name rides
         # in the ``alert=`` attr and must exist in alerts.RULES — linted)
         "alert.firing",
@@ -297,6 +314,9 @@ GAUGE_UNITS = {
     "fleet.healthy_replicas": "count",
     "fleet.breaker_open": "count",
     "fleet.brownout_level": "count",
+    "fleet.replicas": "count",
+    "fleet.draining": "count",
+    "fleet.at_capacity": "count",
     "serve.handoff_ms": "ms",
     "tune.candidates": "count",
     "tune.pruned_oom": "count",

@@ -1,0 +1,697 @@
+"""Fleet autoscaler (maggy_tpu/serve/fleet/autoscale.py): the capacity loop.
+
+The decision ladder (brownout handoff, hysteresis holds, cooldown,
+min/max clamps, headroom gates) is a pure function over frozen
+``Observation`` rows, so it is unit-tested without a fleet — including
+the satellite-4 properties: sustained brownout level >= 2 scales out,
+recovery steps brownout down to 0 *before* any scale-in, and the
+cooldown prevents flapping under the seeded diurnal+burst replay. The
+drain-safe scale events (byte-identical scale-in, kill-mid-drain chaos
+fallback, half-open probation on scale-up) run against real engines on
+CPU, mirroring tests/test_serve_fleet.py.
+"""
+
+import dataclasses
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from maggy_tpu.models import Decoder, DecoderConfig
+from maggy_tpu.models.generate import generate_cached
+from maggy_tpu.parallel.sharding import unbox
+from maggy_tpu.resilience import chaos
+from maggy_tpu.serve import ServeClient
+from maggy_tpu.serve.fleet import (
+    AutoscaleConfig,
+    Autoscaler,
+    ReplicaSpec,
+    Router,
+    RouterConfig,
+    launch_fleet,
+)
+from maggy_tpu.serve.fleet.autoscale import Observation
+from maggy_tpu.serve.fleet.replica import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    DEAD,
+    UP,
+    CircuitBreaker,
+)
+from maggy_tpu.serve.loadgen import diurnal_burst_spec
+from maggy_tpu.serve.loadgen import generate as gen_schedule
+
+CFG = DecoderConfig.tiny(max_seq_len=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = Decoder(CFG)
+    return unbox(
+        model.init(jax.random.key(7), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+
+
+def reference(params, prompt, max_new):
+    decode_model = Decoder(dataclasses.replace(CFG, decode=True))
+    buf = np.zeros((1, len(prompt) + max_new), np.int32)
+    buf[0, : len(prompt)] = prompt
+    out = generate_cached(
+        decode_model, params, jnp.asarray(buf), jnp.asarray([len(prompt)])
+    )
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+# ------------------------------------------------------------ decision ladder
+
+
+def bare_autoscaler(**cfg_kwargs):
+    """An Autoscaler over a stub router: decide() never touches fleet
+    state, so the ladder is testable with no replicas at all."""
+    router = types.SimpleNamespace(
+        replicas=[],
+        telemetry=types.SimpleNamespace(
+            event=lambda *a, **k: None,
+            count=lambda *a, **k: None,
+        ),
+    )
+    return Autoscaler(router, config=AutoscaleConfig(**cfg_kwargs))
+
+
+def obs(now, replicas=2, util=0.5, queue=0, level=0, headroom=0.5):
+    return Observation(
+        now=float(now),
+        replicas=replicas,
+        util=util,
+        queue_depth=queue,
+        brownout_level=level,
+        headroom_pct=headroom,
+    )
+
+
+def test_sustained_brownout_scales_out():
+    a = bare_autoscaler(escalate_hold_s=4.0, high_hold_s=3.0)
+    assert a.decide(obs(0.0, level=2)) is None
+    assert a.decide(obs(2.0, level=2)) is None
+    assert a.decide(obs(4.0, level=2)) == "up"
+
+
+def test_brownout_blip_resets_the_hold():
+    a = bare_autoscaler(escalate_hold_s=4.0)
+    assert a.decide(obs(0.0, level=2)) is None
+    assert a.decide(obs(3.0, level=0)) is None  # ladder recovered: clock resets
+    assert a.decide(obs(5.0, level=3)) is None
+    assert a.decide(obs(8.0, level=3)) is None  # only 3s of the new episode
+    assert a.decide(obs(9.0, level=3)) == "up"
+
+
+def test_high_util_scales_out():
+    a = bare_autoscaler(high_hold_s=3.0, target_util=0.8)
+    assert a.decide(obs(0.0, util=0.95)) is None
+    assert a.decide(obs(2.0, util=0.5)) is None  # dipped: clock resets
+    assert a.decide(obs(3.0, util=0.95)) is None
+    assert a.decide(obs(6.0, util=0.95)) == "up"
+
+
+def test_recovery_steps_brownout_down_before_scale_in():
+    """The ladder unwinds first: the idle clock must not start while the
+    fleet is still degrading requests (brownout > 0), however low the
+    utilization already is."""
+    a = bare_autoscaler(low_hold_s=6.0)
+    assert a.decide(obs(0.0, util=0.1, level=2)) is None
+    assert a.decide(obs(1.0, util=0.1, level=1)) is None
+    assert a.decide(obs(2.0, util=0.1, level=1)) is None
+    # ladder reaches 0 at t=3: the low_hold clock starts HERE, not at t=0
+    assert a.decide(obs(3.0, util=0.1, level=0)) is None
+    assert a.decide(obs(8.5, util=0.1, level=0)) is None
+    assert a.decide(obs(9.0, util=0.1, level=0)) == "down"
+
+
+def test_scale_in_requires_empty_queue():
+    a = bare_autoscaler(low_hold_s=2.0)
+    assert a.decide(obs(0.0, util=0.1)) is None
+    assert a.decide(obs(1.0, util=0.1, queue=3)) is None  # backlog: clock resets
+    assert a.decide(obs(2.0, util=0.1)) is None
+    assert a.decide(obs(4.0, util=0.1)) == "down"
+
+
+def test_scale_in_blocked_without_headroom():
+    a = bare_autoscaler(low_hold_s=1.0, min_headroom_pct=0.05)
+    assert a.decide(obs(0.0, util=0.1, headroom=0.01)) is None
+    assert a.decide(obs(1.0, util=0.1, headroom=0.01)) is None  # HBM tight
+    assert a.decide(obs(1.2, util=0.1, headroom=0.40)) == "down"
+
+
+def test_scale_in_blocked_when_survivors_would_run_hot():
+    a = bare_autoscaler(low_hold_s=1.0, target_util=0.4, low_util=0.35)
+    assert a.decide(obs(0.0, replicas=2, util=0.3)) is None
+    # idle held, but 2 -> 1 projects 0.6 utilization > 0.4 target
+    assert a.decide(obs(1.5, replicas=2, util=0.3)) is None
+    b = bare_autoscaler(low_hold_s=1.0, target_util=0.4, low_util=0.35)
+    assert b.decide(obs(0.0, replicas=3, util=0.2)) is None
+    # 3 -> 2 projects 0.3 < 0.4: safe
+    assert b.decide(obs(1.5, replicas=3, util=0.2)) == "down"
+
+
+def test_max_clamp_journals_at_capacity_once():
+    a = bare_autoscaler(max_replicas=2, escalate_hold_s=1.0)
+    assert a.decide(obs(0.0, replicas=2, level=2)) is None
+    assert a.decide(obs(1.0, replicas=2, level=2)) is None  # pinned at max
+    assert a.at_capacity()
+    assert a.decide(obs(2.0, replicas=2, level=2)) is None
+
+    def blocked():
+        return [e for e in a.events if e["event"] == "fleet.scale.blocked"]
+
+    assert len(blocked()) == 1  # one journal entry per pressure episode
+    # pressure clears: flag drops, a later episode journals again
+    assert a.decide(obs(3.0, replicas=2, level=0)) is None
+    assert not a.at_capacity()
+    assert a.decide(obs(4.0, replicas=2, level=2)) is None
+    assert a.decide(obs(5.0, replicas=2, level=2)) is None
+    assert len(blocked()) == 2
+
+
+def test_min_clamp_blocks_scale_in():
+    a = bare_autoscaler(min_replicas=1, low_hold_s=1.0)
+    assert a.decide(obs(0.0, replicas=1, util=0.0)) is None
+    assert a.decide(obs(2.0, replicas=1, util=0.0)) is None
+
+
+def test_cooldown_prevents_flapping_under_burst_replay():
+    """Satellite 4: replay the seeded diurnal+burst schedule through the
+    ladder as a synthetic utilization series; every pair of scale events
+    must be separated by the cooldown, and the burst must still force at
+    least one scale-out."""
+    spec = diurnal_burst_spec(
+        seed=7, duration_s=60.0, base_rps=3.0, burst_mult=6.0
+    )
+    per_sec = [0] * 60
+    for arrival in gen_schedule(spec):
+        per_sec[min(59, int(arrival.at_s))] += 1
+    a = bare_autoscaler(
+        max_replicas=4,
+        scale_cooldown_s=10.0,
+        escalate_hold_s=4.0,
+        high_hold_s=2.0,
+        low_hold_s=5.0,
+    )
+    replicas, events = 1, []
+    for sec, rate in enumerate(per_sec):
+        # 3 slots per replica; offered rate saturates them linearly
+        util = min(1.0, rate / (3.0 * replicas))
+        action = a.decide(obs(float(sec), replicas=replicas, util=util))
+        if action == "up":
+            replicas += 1
+        elif action == "down":
+            replicas -= 1
+        if action:
+            a._last_event_ts = float(sec)  # what actuation would stamp
+            events.append((sec, action))
+    assert any(kind == "up" for _, kind in events), (
+        f"burst never forced a scale-out: {events}"
+    )
+    gaps = [t2 - t1 for (t1, _), (t2, _) in zip(events, events[1:])]
+    assert all(g >= 10.0 for g in gaps), (
+        f"scale events inside the cooldown window: {events}"
+    )
+    assert 1 <= replicas <= 4
+
+
+# ------------------------------------------------------- chaos kinds (sat 3)
+
+
+def test_guard_defers_rollback_while_storm_persists(monkeypatch):
+    """The post-scale-up guard must not revert capacity while the very
+    overload that triggered the scale-out is still blowing attainment
+    down (doomed backlog completing late): the window re-arms against
+    the degraded baseline instead, and judges again once pressure moves
+    — the no-fight rule, applied to the guard itself."""
+    a = bare_autoscaler(guard_window_s=1.0, regress_tol=0.1)
+    a._guard = {"direction": "up", "since": 0.0, "baseline": 0.9, "replica": 7}
+    monkeypatch.setattr(a, "_attainment", lambda now, w: 0.1)
+    monkeypatch.setattr(a, "observe", lambda now: obs(now, level=3))
+    a._tick_guard(2.0)
+    assert a._guard is not None  # deferred, not rolled back
+    assert a._guard["since"] == 2.0 and a._guard["baseline"] == 0.1
+    assert a.events[-1]["event"] == "fleet.scale.guard_extended"
+    assert a.events[-1]["brownout"] == 3
+    # pressure moved: attainment holds against the re-armed baseline
+    monkeypatch.setattr(a, "observe", lambda now: obs(now, level=0, util=0.2))
+    monkeypatch.setattr(a, "_attainment", lambda now, w: 0.6)
+    a._tick_guard(4.0)
+    assert a._guard is None
+    assert a.events[-1]["event"] == "fleet.scale.committed"
+
+
+def test_new_chaos_kinds_are_declared():
+    assert "replica_spawn_slow" in chaos.KINDS
+    assert "replica_kill_mid_drain" in chaos.KINDS
+
+
+def test_replica_spawn_slow_seam():
+    ch = chaos.Chaos.parse("replica_spawn_slow:replica=2,secs=0.5")
+    assert ch.replica_spawn_slow(1) == 0.0  # wrong replica: no fault
+    assert ch.replica_spawn_slow(2) == 0.5
+    assert ch.replica_spawn_slow(2) == 0.0  # budget (times=1) consumed
+
+
+def test_replica_kill_mid_drain_seam():
+    ch = chaos.Chaos.parse("replica_kill_mid_drain:replica=1")
+    assert ch.replica_kill_mid_drain(0) is False
+    assert ch.replica_kill_mid_drain(1) is True
+    assert ch.replica_kill_mid_drain(1) is False  # fires exactly once
+
+
+# ----------------------------------------- breaker probation + reset (sat 1)
+
+
+def test_breaker_reset_returns_to_pristine_closed():
+    br = CircuitBreaker(0, trips=1, cooldown_s=5.0)
+    now = time.time()
+    assert br.score(1000.0, 10.0, 3.0, 50.0, now) == "opened"
+    assert br.state == BREAKER_OPEN
+    br.reset()
+    assert br.state == BREAKER_CLOSED
+    assert br.ok(now)  # dispatchable immediately, no cooldown ghost
+
+
+def test_breaker_probation_gate():
+    br = CircuitBreaker(1, trips=2, cooldown_s=5.0)
+    br.begin_probation(close_below_ms=100.0)
+    assert br.state == BREAKER_HALF_OPEN
+    assert br.take_probe("r1")
+    assert not br.take_probe("r2")  # one canary at a time
+    br.observe_ttft("r1", 50.0, time.time())  # under the bar: closes
+    assert br.state == BREAKER_CLOSED
+    # a slow probe re-opens instead
+    br2 = CircuitBreaker(2, trips=2, cooldown_s=5.0)
+    br2.begin_probation(close_below_ms=100.0)
+    assert br2.take_probe("r9")
+    br2.observe_ttft("r9", 500.0, time.time())
+    assert br2.state == BREAKER_OPEN
+
+
+def fake_replica(index, state=UP, num_slots=4):
+    """A replica-shaped namespace for router unit tests (no engine)."""
+    return types.SimpleNamespace(
+        index=index,
+        state=state,
+        spec=types.SimpleNamespace(num_slots=num_slots, role="any"),
+        describe=lambda: {"replica": index, "state": state, "addr": None,
+                          "restarts": 0, "devices": [], "uptime_s": 0.0},
+        client=None,
+        stop=lambda drain=True, timeout=30.0: None,
+        kill=lambda: None,
+        respawn=lambda: ("127.0.0.1", 9999),
+    )
+
+
+def test_respawn_resets_breaker_window_and_metrics():
+    """Satellite 1: a respawned replica shares nothing with the dead one.
+    Its breaker must come back pristine CLOSED and its pre-death
+    SeriesStore must be dropped, or stale latency samples re-open the
+    breaker / re-trip alerts on the fresh stack."""
+    dead = fake_replica(0, state=DEAD)
+    router = Router([dead], config=RouterConfig(max_restarts=1))
+    # the pre-death state a naive respawn would leak: an OPEN breaker
+    # (probation probe lost when the replica died) + a latency store
+    router.breakers[0].begin_probation(100.0)
+    router.breakers[0].take_probe("ghost")
+    router.replica_metrics[0] = object()
+    router._handle_replica_down(dead)
+    assert router.counters["respawned"] == 1
+    assert router.breakers[0].state == BREAKER_CLOSED
+    assert router.breakers[0].ok(time.time())
+    assert 0 not in router.replica_metrics
+    assert 0 not in router._down_handled
+
+
+def test_respawn_suppressed_for_draining_replica():
+    """A death mid-drain is the kill-mid-drain fallback: requeue happens,
+    but the victim being deliberately removed must never respawn."""
+    dead = fake_replica(0, state=DEAD)
+    router = Router([dead], config=RouterConfig(max_restarts=1))
+    router.begin_drain(0)
+    router._handle_replica_down(dead)
+    assert router.counters["respawned"] == 0
+    assert router._restarts_used == 0
+
+
+# ------------------------------------------------ retire forgets all (sat 2)
+
+
+def test_retire_forgets_every_per_replica_trace():
+    r0, r1 = fake_replica(0), fake_replica(1)
+    router = Router([r0, r1], config=RouterConfig())
+    router.prefix_map.update(0, ["d0"])
+    router.prefix_map.update(1, ["d1", "shared"])
+    router.prefix_map.update(0, ["shared"])
+    router._stats_cache[1] = {"active_slots": 0}
+    router.replica_metrics[1] = object()
+    router.begin_drain(1)
+    with router._lock:
+        assert router._fleet_stats()["replicas"][1]["state"] == "draining"
+    router.retire_replica(r1)
+    assert [r.index for r in router.replicas] == [0]
+    assert 1 not in router.breakers
+    assert 1 not in router.retry_budgets
+    assert 1 not in router._stats_cache
+    assert 1 not in router.replica_metrics
+    assert 1 not in router._draining
+    # the prefix map forgets the victim but keeps survivors' entries
+    assert router.prefix_map.replicas_for("d1") == frozenset()
+    assert router.prefix_map.replicas_for("shared") == frozenset({0})
+    # and FSTATS carries no ghost row
+    with router._lock:
+        rows = router._fleet_stats()["replicas"]
+    assert [row["replica"] for row in rows] == [0]
+
+
+def test_admit_replica_builds_fresh_probation_breaker():
+    r0 = fake_replica(0)
+    router = Router([r0], config=RouterConfig(slo_ttft_ms=800.0))
+    fresh = fake_replica(5)
+    router.admit_replica(fresh, probation=True)
+    assert [r.index for r in router.replicas] == [0, 5]
+    assert router.breakers[5].state == BREAKER_HALF_OPEN
+    assert 5 in router.retry_budgets
+    # index allocator never reuses: next spawn is past the admitted one
+    assert router.allocate_index() == 6
+
+
+def test_rebalance_excess_sheds_pinned_backlog():
+    """When capacity comes online, routed-but-unstarted work pinned to
+    the overloaded replica is requeued to the shared queue (oldest two
+    waves per slot stay put; the shed tail keeps its original order,
+    ahead of fresh arrivals)."""
+    from maggy_tpu.serve.fleet.router import ROUTED, REQUEUED, RouteEntry
+
+    r0 = fake_replica(0, num_slots=1)
+    router = Router([r0], config=RouterConfig())
+    for i in range(5):
+        e = RouteEntry(
+            rid=f"r{i}", payload={"prompt": [1, 2, 3], "qos": "standard"},
+            state=ROUTED, replica=0, submitted_ts=100.0 + i,
+        )
+        router._entries[e.rid] = e
+    # one stream already producing tokens and one finished: both stay
+    started = RouteEntry(
+        rid="started", payload={"prompt": [4]}, state=ROUTED, replica=0,
+        snapshot={"n_tokens": 2}, submitted_ts=90.0,
+    )
+    finished = RouteEntry(
+        rid="fin", payload={"prompt": [5]}, state=ROUTED, replica=0,
+        final={"done": True, "state": "done"}, submitted_ts=91.0,
+    )
+    router._entries["started"] = started
+    router._entries["fin"] = finished
+    moved = router.rebalance_excess()
+    assert moved == 3  # keep = 2 slots x 1; r0/r1 stay bound, r2-r4 shed
+    assert router.counters["requeued"] == 3
+    assert list(router._pending) == ["r2", "r3", "r4"]  # original order
+    for rid in ("r2", "r3", "r4"):
+        e = router._entries[rid]
+        assert e.state == REQUEUED and e.replica is None and e.resubmits == 1
+    for rid in ("r0", "r1", "started", "fin"):
+        assert router._entries[rid].state == ROUTED
+        assert router._entries[rid].replica == 0
+    # idempotent: nothing left above the per-slot keep line
+    assert router.rebalance_excess() == 0
+
+
+# --------------------------------------------- scale events on real engines
+
+
+# holds and cooldown pinned far out so decide() never fires on its own:
+# these tests drive scale events directly and assert the drain/warm
+# machinery, not the (unit-tested) ladder timing
+EVENT_CFG = dict(
+    min_replicas=1,
+    max_replicas=2,
+    scale_cooldown_s=600.0,
+    escalate_hold_s=600.0,
+    high_hold_s=600.0,
+    low_hold_s=600.0,
+    guard_window_s=0.5,
+    drain_grace_s=0.4,
+    drain_timeout_s=30.0,
+    warm_timeout_s=240.0,
+)
+
+
+def _drive(host, port, secret, prompts, max_new, results, errors, stagger=0.03):
+    threads = []
+
+    def one(i, prompt, delay):
+        try:
+            time.sleep(delay)
+            with ServeClient((host, port), secret) as client:
+                results[i] = client.generate(prompt, max_new=max_new, timeout=240)
+        except Exception as e:  # noqa: BLE001 - surfaced via the errors list
+            errors.append((i, repr(e)))
+
+    for i, p in enumerate(prompts):
+        t = threading.Thread(target=one, args=(i, p, stagger * i))
+        t.start()
+        threads.append(t)
+    return threads
+
+
+def _wait_retired(router, index, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if router._replica(index) is None:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_scale_in_drain_is_byte_identical(params):
+    """Drain-based scale-in mid-traffic: every request completes with
+    tokens byte-identical to single-engine decode — finished on the
+    victim inside the grace, or spilled + requeued to the survivor."""
+    router = launch_fleet(
+        ReplicaSpec(CFG, params, num_slots=2),
+        replicas=2,
+        autoscale=AutoscaleConfig(**EVENT_CFG),
+    )
+    host, port = router.start(host="127.0.0.1")
+    prompts = [
+        [1, 2, 3], [4, 5, 6, 7], [8, 9], [10, 11, 12],
+        [13, 14, 15, 16], [3, 1, 4],
+    ]
+    max_new = 8
+    results, errors = {}, []
+    try:
+        threads = _drive(host, port, router.secret, prompts, max_new,
+                         results, errors)
+        time.sleep(0.5)  # let dispatch spread waves over both replicas
+        victim = router._replica(1)
+        assert victim is not None
+        router.autoscaler._begin_scale_down(
+            time.time(), reason="test", victim=victim
+        )
+        for t in threads:
+            t.join(timeout=240)
+        assert not errors, errors
+        assert len(results) == len(prompts)
+        for i, prompt in enumerate(prompts):
+            assert results[i] == reference(params, prompt, max_new), (
+                f"request {i} diverges across the scale-in drain"
+            )
+        assert _wait_retired(router, 1), "victim never retired"
+        events = [e["event"] for e in router.autoscaler.snapshot()["events"]]
+        assert "fleet.scale.down" in events
+        assert "fleet.scale.retired" in events
+        assert router.counters["failed"] == 0
+    finally:
+        router.stop()
+
+
+def test_kill_mid_drain_falls_back_to_requeue(params):
+    """Chaos kills the victim while its drain is in progress: the down
+    path requeues its streams (no respawn — it was being removed), the
+    autoscaler finishes the retire, and completions stay byte-identical."""
+    chaos.install(chaos.Chaos.parse("replica_kill_mid_drain:replica=1"))
+    router = launch_fleet(
+        ReplicaSpec(CFG, params, num_slots=2),
+        replicas=2,
+        autoscale=AutoscaleConfig(**EVENT_CFG),
+    )
+    host, port = router.start(host="127.0.0.1")
+    prompts = [[2, 3, 4], [5, 6, 7, 8], [9, 10], [11, 12, 13], [1, 2]]
+    max_new = 8
+    results, errors = {}, []
+    try:
+        threads = _drive(host, port, router.secret, prompts, max_new,
+                         results, errors)
+        time.sleep(0.5)
+        victim = router._replica(1)
+        assert victim is not None
+        router.autoscaler._begin_scale_down(
+            time.time(), reason="test", victim=victim
+        )
+        for t in threads:
+            t.join(timeout=240)
+        assert not errors, errors
+        for i, prompt in enumerate(prompts):
+            assert results[i] == reference(params, prompt, max_new), (
+                f"request {i} diverges across the kill-mid-drain fallback"
+            )
+        assert _wait_retired(router, 1), "victim never retired"
+        retired = [
+            e for e in router.autoscaler.snapshot()["events"]
+            if e["event"] == "fleet.scale.retired"
+        ]
+        assert retired and retired[0]["mode"] == "kill_fallback"
+        assert router.counters["respawned"] == 0
+    finally:
+        chaos.reset()
+        router.stop()
+
+
+def test_scale_up_admits_behind_probation_gate(params):
+    """Scale-up warms off-pump (compile + probe) and admits HALF_OPEN:
+    the first real request is the canary that closes the breaker, and its
+    tokens match single-engine decode."""
+    router = launch_fleet(
+        ReplicaSpec(CFG, params, num_slots=2),
+        replicas=1,
+        config=RouterConfig(slo_ttft_ms=5000.0),
+        autoscale=AutoscaleConfig(**EVENT_CFG),
+    )
+    host, port = router.start(host="127.0.0.1")
+    try:
+        with ServeClient((host, port), router.secret) as client:
+            client.generate([1, 2, 3], max_new=2, timeout=240)  # warm r0
+            router.autoscaler._begin_scale_up(time.time(), reason="test")
+            deadline = time.time() + 240
+            while time.time() < deadline and len(router.replicas) < 2:
+                time.sleep(0.05)
+            assert len(router.replicas) == 2, "warmed replica never admitted"
+            breaker = router.breakers[1]
+            # admitted in probation: no traffic has closed it yet
+            assert breaker.state == BREAKER_HALF_OPEN
+            prompts = [[5, 6, 7], [8, 9], [2, 4, 6], [1, 3, 5, 7]]
+            outs = [
+                client.generate(p, max_new=4, timeout=240) for p in prompts
+            ]
+            deadline = time.time() + 30
+            while time.time() < deadline and breaker.state != BREAKER_CLOSED:
+                time.sleep(0.05)
+            assert breaker.state == BREAKER_CLOSED, (
+                "probation canary never closed the breaker"
+            )
+            for p, out in zip(prompts, outs):
+                assert out == reference(params, p, 4)
+        events = [e["event"] for e in router.autoscaler.snapshot()["events"]]
+        assert "fleet.scale.up" in events
+        assert "fleet.scale.admitted" in events
+    finally:
+        router.stop()
+
+
+def test_monitor_renders_autoscale_line_and_draining_tag():
+    from maggy_tpu.monitor import render_status
+
+    out = render_status(
+        {
+            "kind": "ServeFleet",
+            "name": "fleet",
+            "state": "RUNNING",
+            "app_id": "a",
+            "run_id": 1,
+            "elapsed_s": 4.0,
+            "fleet": {
+                "routing": {"routed": 9, "requeued": 1, "shed": 0,
+                            "respawned": 0},
+                "replicas": [
+                    {"replica": 0, "state": "up", "active_slots": 1,
+                     "num_slots": 2, "queue_depth": 0, "requests_done": 5,
+                     "prefix_hits": 0},
+                    {"replica": 1, "state": "draining", "active_slots": 1,
+                     "num_slots": 2, "queue_depth": 0, "requests_done": 4,
+                     "prefix_hits": 0},
+                ],
+            },
+            "serve": {
+                "queue_depth": 0,
+                "requests_done": 9,
+                "autoscale": {
+                    "phase": "draining",
+                    "min_replicas": 1,
+                    "max_replicas": 4,
+                    "at_capacity": False,
+                    "last_event": {"event": "fleet.scale.down",
+                                   "reason": "idle"},
+                },
+            },
+        }
+    )
+    assert "autoscale: 2 replicas [1..4]" in out
+    assert "phase=draining" in out
+    assert "last=fleet.scale.down(idle)" in out
+    assert "DRAI" in out
+
+
+@pytest.mark.slow
+def test_burst_drives_scale_out_end_to_end(params):
+    """The full loop under the canned diurnal+burst replay: sustained
+    pressure walks the brownout ladder, the autoscaler scales out, and
+    no request fails across the scale event."""
+    from maggy_tpu.serve import TrafficReplay
+    from maggy_tpu.serve.qos import STANDARD
+
+    router = launch_fleet(
+        ReplicaSpec(CFG, params, num_slots=2, paged=True, num_pages=8),
+        replicas=1,
+        config=RouterConfig(
+            slo_ttft_ms=400.0,
+            admission="queue",
+            brownout_escalate_s=0.3,
+            brownout_recover_s=1.0,
+        ),
+        autoscale=AutoscaleConfig(
+            min_replicas=1,
+            max_replicas=2,
+            scale_cooldown_s=3.0,
+            escalate_hold_s=0.5,
+            high_hold_s=0.5,
+            low_hold_s=2.0,
+            guard_window_s=1.0,
+            drain_grace_s=0.5,
+            warm_timeout_s=240.0,
+        ),
+    )
+    host, port = router.start(host="127.0.0.1")
+    try:
+        with ServeClient((host, port), router.secret) as client:
+            # warm with standard class: best-effort warmups would be held
+            # by the SLO queue-gate once the first compile inflates the
+            # TTFT projection
+            for i in range(4):
+                client.generate(list(range(1 + i, 13 + i)), max_new=2,
+                                qos=STANDARD, timeout=240)
+            spec = diurnal_burst_spec(
+                seed=7, duration_s=10.0, base_rps=6.0, burst_mult=6.0
+            )
+            outcomes = TrafficReplay(
+                client, gen_schedule(spec), result_timeout_s=60.0
+            ).run(timeout=240.0)
+        events = [e["event"] for e in router.autoscaler.snapshot()["events"]]
+        assert "fleet.scale.up" in events, (
+            f"burst never drove a scale-out: {events}"
+        )
+        failed = [
+            o for o in outcomes
+            if o["status"] in ("failed", "submit_error")
+        ]
+        assert not failed, failed
+    finally:
+        router.stop()

@@ -94,6 +94,7 @@ REQUIRED_MODELS: Tuple[Tuple[str, str, str], ...] = (
     (os.path.join("maggy_tpu", "serve", "tier", "host_pool.py"), "HostPagePool", "_lock"),
     (os.path.join("maggy_tpu", "serve", "tier", "tiering.py"), "TieringPolicy", "_lock"),
     (os.path.join("maggy_tpu", "serve", "tier", "prefixmap.py"), "FleetPrefixMap", "_lock"),
+    (os.path.join("maggy_tpu", "serve", "fleet", "autoscale.py"), "Autoscaler", "_lock"),
 )
 
 

@@ -220,6 +220,69 @@ def check_tier_series(registry) -> List[str]:
     return out
 
 
+# the autoscaler observability contract (docs/fleet.md "Autoscaling"): the
+# fleet.* capacity-loop series must stay registered under exactly these
+# kinds with these units — the bench autoscale gate, the monitor autoscale
+# line, and alert.fleet_at_capacity all key on them.
+AUTOSCALE_SERIES = {
+    "fleet.replicas": ("gauge", "count"),
+    "fleet.draining": ("gauge", "count"),
+    "fleet.at_capacity": ("gauge", "count"),
+    "fleet.scale_events": ("count", "count"),
+    "fleet.drain_ms": ("histogram", "ms"),
+}
+
+# the autoscaler's decision journal: every fleet.scale.* milestone must be
+# a registered event — tests and ops tooling replay scale decisions from
+# these names, so the set is pinned closed here
+AUTOSCALE_EVENTS = (
+    "fleet.scale.up",
+    "fleet.scale.down",
+    "fleet.scale.admitted",
+    "fleet.scale.retired",
+    "fleet.scale.committed",
+    "fleet.scale.rollback",
+    "fleet.scale.guard_extended",
+    "fleet.scale.blocked",
+)
+
+
+def check_autoscale_series(registry, alerts) -> List[str]:
+    """Every pinned autoscale series/event is registered under the
+    expected kind, and the at-capacity alert reads the pinned gauge."""
+    out: List[str] = []
+    units = getattr(registry, "UNITS", {})
+    for name, (kind, unit) in sorted(AUTOSCALE_SERIES.items()):
+        allowed = registry.BY_KIND.get(kind, frozenset())
+        if name not in allowed:
+            out.append(
+                f"autoscale series {name!r} must be registered as a {kind} "
+                "in telemetry/metrics.py"
+            )
+            continue
+        got = units.get(name)
+        if got != unit:
+            out.append(
+                f"autoscale series {name!r}: unit {got!r}, expected {unit!r}"
+            )
+    for name in AUTOSCALE_EVENTS:
+        if name not in registry.EVENTS:
+            out.append(
+                f"autoscale journal event {name!r} missing from metrics.EVENTS"
+            )
+    rule = {r.name: r for r in getattr(alerts, "RULES", ())}.get(
+        "alert.fleet_at_capacity"
+    )
+    if rule is None:
+        out.append("rule 'alert.fleet_at_capacity' missing from alerts.RULES")
+    elif rule.kind != "threshold" or rule.metric != "fleet.at_capacity":
+        out.append(
+            "alerts.RULES['alert.fleet_at_capacity'] must be a threshold "
+            "rule over the 'fleet.at_capacity' gauge"
+        )
+    return out
+
+
 def _receiver_is_telemetry(expr: ast.AST) -> bool:
     """True when the call receiver plausibly is a telemetry recorder: some
     identifier in its chain contains 'tel'. Keeps ``"abc".count("a")`` and
@@ -318,6 +381,10 @@ def main(argv=None) -> int:
     )
     violations.extend(
         (alerts_path, 0, what) for what in check_capacity_rules(alerts)
+    )
+    violations.extend(
+        (reg_path, 0, what)
+        for what in check_autoscale_series(registry, alerts)
     )
     alert_names = {r.name for r in alerts.RULES} | {
         alerts.ALERT_FIRING,
